@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 ImageNet-shape training throughput on one TPU chip.
+
+Mirrors the reference's headline benchmark
+(`example/image-classification/train_imagenet.py --benchmark 1`, bs32 —
+BASELINE.md: 181.53 img/s on P100).  Synthetic data (as --benchmark 1 uses),
+full training step: forward + backward through the jitted executor +
+SGD-momentum update.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # ResNet-50 train bs32, P100 (docs/how_to/perf.md:188)
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu import ndarray as nd
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+    n_iters = int(os.environ.get("BENCH_ITERS", "20"))
+    warmup = 5
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    ctx = mx.tpu() if platform != "cpu" else mx.cpu()
+
+    net = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224))
+    mod = mx.mod.Module(net, context=ctx)
+    mod.bind(data_shapes=[("data", (batch_size, 3, 224, 224))],
+             label_shapes=[("softmax_label", (batch_size,))])
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                         "wd": 1e-4})
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (batch_size, 3, 224, 224)).astype(np.float32),
+                 ctx=ctx)
+    y = nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32), ctx=ctx)
+    batch = DataBatch([x], [y])
+
+    def sync():
+        # on the tunneled TPU platform block_until_ready can return early;
+        # fetching a value derived from the last update is a reliable fence
+        import jax.numpy as jnp
+
+        return float(jnp.sum(mod._exec_group.param_arrays[-1].data))
+
+    for _ in range(warmup):
+        mod.forward_backward(batch)
+        mod.update()
+    sync()
+
+    tic = time.time()
+    for _ in range(n_iters):
+        mod.forward_backward(batch)
+        mod.update()
+    sync()
+    toc = time.time()
+
+    img_s = batch_size * n_iters / (toc - tic)
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_bs%d" % batch_size,
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Imperative autograd (tape-based).
+
+Reference: `src/ndarray/autograd.{h,cc}` + `python/mxnet/contrib/autograd.py`
+— MarkVariables attaches grad buffers, executed imperative ops are recorded
+into an AGNode tape, ComputeGradient builds a graph and drives a backward
+executor.  TPU-native: the tape records (op, attrs, inputs, outputs); the
+backward pass replays the tape as a pure JAX function of the marked
+variables and differentiates it with ``jax.vjp`` — jax AD replaces the
+hand-built gradient graph.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["is_recording", "is_training", "set_is_training", "mark_variables",
+           "backward", "compute_gradient", "record", "train_section",
+           "test_section", "grad_and_loss", "grad"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []          # list of (opdef, attrs, input_ids, out_ids)
+        _state.values = {}        # id -> NDArray (kept alive while recording)
+        _state.variables = {}     # id -> (NDArray, grad NDArray)
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_is_training(train_mode):
+    prev = _st().training
+    _st().training = bool(train_mode)
+    return prev
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference: autograd.py:87 MarkVariables)."""
+    st = _st()
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        st.variables[id(var)] = (var, grad, req)
+        st.values[id(var)] = var
+
+
+def record_op(opdef, attrs, inputs, outputs, rng=None, aux=()):
+    """Called from imperative_invoke while recording."""
+    st = _st()
+    aux = list(aux)
+    for nd in inputs + outputs + aux:
+        st.values[id(nd)] = nd
+    st.tape.append(
+        (opdef, attrs, [id(i) for i in inputs], [id(o) for o in outputs], rng,
+         [id(a) for a in aux]))
+
+
+class record:
+    """``with autograd.record():`` — recording + train mode scope."""
+
+    def __init__(self, train_mode=True):
+        self._train = train_mode
+        self._prev = None
+        self._prev_train = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = st.recording
+        self._prev_train = st.training
+        st.recording = True
+        st.training = self._train
+        if not self._prev:
+            st.tape = []
+            st.values = {vid: v for vid, v in st.values.items()
+                         if vid in st.variables}
+        return self
+
+    def __exit__(self, *args):
+        st = _st()
+        st.recording = self._prev
+        st.training = self._prev_train
+
+
+# reference contrib.autograd API names
+class train_section(record):
+    def __init__(self):
+        super().__init__(train_mode=True)
+
+
+class test_section(record):
+    def __init__(self):
+        super().__init__(train_mode=False)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Compute gradients of outputs w.r.t. marked variables, accumulate
+    into their grad buffers (reference: autograd.py:60 backward)."""
+    compute_gradient(outputs, out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs, out_grads=None, retain_graph=False):
+    import jax
+    import jax.numpy as jnp
+
+    st = _st()
+    if not st.variables:
+        raise MXNetError("no variables marked for gradient")
+    var_ids = list(st.variables.keys())
+    out_ids = [id(o) for o in outputs]
+
+    # values of non-variable leaves captured as constants
+    tape = list(st.tape)
+
+    def replay(var_vals):
+        env = {vid: v for vid, v in zip(var_ids, var_vals)}
+
+        def lookup(nid):
+            if nid in env:
+                return env[nid]
+            return st.values[nid].data
+
+        from .registry import OpContext
+
+        for opdef, attrs, in_ids, o_ids, rng, aux_ids in tape:
+            ins = [lookup(i) for i in in_ids]
+            # aux states replay as constants (non-differentiated)
+            auxs = [jax.lax.stop_gradient(lookup(a)) for a in aux_ids]
+            octx = OpContext(is_train=True,
+                             rng=rng if rng is not None else jax.random.PRNGKey(0))
+            outs, _ = opdef.fcompute(attrs, ins, auxs, octx)
+            for oid, val in zip(o_ids, outs):
+                env[oid] = val
+        return [env[o] if o in env else st.values[o].data for o in out_ids]
+
+    var_vals = [st.variables[vid][0].data for vid in var_ids]
+    out_vals, vjp_fn = jax.vjp(lambda *vs: replay(list(vs)), *var_vals)
+    if out_grads is None:
+        cts = [jnp.ones_like(o) for o in out_vals]
+    else:
+        cts = [g.data for g in out_grads]
+    grads = vjp_fn(list(cts))
+    grad_nds = []
+    for vid, g in zip(var_ids, grads):
+        var, grad_buf, req = st.variables[vid]
+        if req == "add":
+            grad_buf._set_data((grad_buf.data + g).astype(grad_buf.data.dtype))
+        elif req != "null":
+            grad_buf._set_data(g.astype(grad_buf.data.dtype))
+        grad_nds.append(grad_buf)
+    if not retain_graph:
+        st.tape = []
+        # drop recorded intermediates so device buffers are released;
+        # keep only the marked variables
+        st.values = {vid: st.values[vid] for vid in var_ids if vid in st.values}
+    return grad_nds
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator returning (gradients, loss) (reference: autograd.py:117)."""
+
+    def wrapped(*args):
+        import jax
+
+        nds = list(args)
+        idx = range(len(nds)) if argnum is None else (
+            [argnum] if isinstance(argnum, int) else argnum)
+
+        idx = list(idx)
+
+        def fn(*vals):
+            from .ndarray import NDArray
+
+            by_pos = dict(zip(idx, vals))
+            full = [NDArray(by_pos[i], nds[i]._ctx) if i in by_pos else nds[i]
+                    for i in range(len(nds))]
+            out = func(*full)
+            return out.data
+
+        vals = [nds[i].data for i in idx]
+        loss, vjp_fn = jax.vjp(fn, *vals)
+        import jax.numpy as jnp
+
+        grads = vjp_fn(jnp.ones_like(loss))
+        from .ndarray import NDArray
+
+        ctx = nds[0]._ctx
+        return [NDArray(g, ctx) for g in grads], NDArray(loss, ctx)
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    def wrapped(*args):
+        return grad_and_loss(func, argnum)(*args)[0]
+
+    return wrapped

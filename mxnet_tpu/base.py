@@ -1,0 +1,115 @@
+"""Base utilities: errors, name management, attribute scopes.
+
+TPU-native re-design of the reference's base layer
+(`/root/reference/python/mxnet/base.py`, `python/mxnet/name.py`,
+`python/mxnet/attribute.py`).  There is no ctypes FFI here: the "C ABI" of
+the reference collapses into direct Python dispatch onto JAX; a real C ABI
+for non-Python frontends lives in src/ (native runtime).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["MXNetError", "NameManager", "AttrScope", "string_types", "numeric_types"]
+
+string_types = (str,)
+numeric_types = (float, int)
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (reference: python/mxnet/base.py:38)."""
+
+
+class _ScopeStack(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+class NameManager:
+    """Automatic symbol naming (reference: python/mxnet/name.py:6-60).
+
+    Assigns ``{op}{count}`` names to anonymous symbols, e.g. ``convolution0``.
+    """
+
+    _state = _ScopeStack()
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        NameManager._state.stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        NameManager._state.stack.pop()
+
+    @classmethod
+    def current(cls):
+        if not cls._state.stack:
+            cls._state.stack.append(NameManager())
+        return cls._state.stack[-1]
+
+
+class Prefix(NameManager):
+    """Prefixing name manager (reference: python/mxnet/name.py:63-79)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+class AttrScope:
+    """Attribute scoping for symbols (reference: python/mxnet/attribute.py).
+
+    ``with mx.AttrScope(ctx_group='dev1'):`` attaches attributes to every
+    symbol created inside the scope — this is how model parallelism
+    (`group2ctx`) is expressed.
+    """
+
+    _state = _ScopeStack()
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, string_types):
+                raise ValueError("Attributes need to be strings")
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge user-supplied attrs with scope attrs (user wins)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        # inherit outer scope attributes
+        if AttrScope._state.stack:
+            merged = AttrScope._state.stack[-1]._attr.copy()
+            merged.update(self._attr)
+            self._attr = merged
+        AttrScope._state.stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        AttrScope._state.stack.pop()
+
+    @classmethod
+    def current(cls):
+        if not cls._state.stack:
+            cls._state.stack.append(AttrScope())
+        return cls._state.stack[-1]

@@ -1,0 +1,127 @@
+"""Device context.
+
+Reference: `/root/reference/python/mxnet/context.py` and
+`include/mxnet/base.h` (Context struct).  TPU-native redesign: a Context is a
+named handle onto a JAX device.  ``mx.cpu(i)`` maps to host (XLA-CPU)
+devices; ``mx.tpu(i)`` maps to TPU chips.  ``mx.gpu(i)`` is accepted as an
+alias for ``tpu`` so reference-era scripts run unchanged — on this framework
+the accelerator is a TPU.
+
+Device ids beyond the number of physical devices wrap around (the reference
+uses fake `mx.cpu(N)` contexts to test model parallelism on one box —
+tests/python/unittest/test_multi_device_exec.py:20 — and we keep that trick:
+distinct contexts remain distinct keys for placement even when they share
+hardware).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_devices"]
+
+_devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+_devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+
+
+class Context:
+    """A device context (reference: python/mxnet/context.py:8-88)."""
+
+    _state = threading.local()
+    devtype2str = _devtype2str
+    devstr2type = _devstr2type
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = _devstr2type[device_type]
+            self.device_id = device_id
+
+    @property
+    def device_type(self):
+        return _devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    # -- JAX mapping ------------------------------------------------------
+    @property
+    def jax_device(self):
+        """The concrete ``jax.Device`` this context maps onto."""
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned"):
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                devs = jax.devices()
+        else:  # gpu / tpu → accelerator platform, fall back to default
+            devs = _accelerator_devices()
+        return devs[self.device_id % len(devs)]
+
+    def __enter__(self):
+        if not hasattr(Context._state, "stack"):
+            Context._state.stack = []
+        Context._state.stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        Context._state.stack.pop()
+
+
+def _accelerator_devices():
+    """TPU devices, else whatever the default platform offers (CPU in tests)."""
+    import jax
+
+    for plat in ("tpu", "axon"):
+        try:
+            return jax.devices(plat)
+        except RuntimeError:
+            continue
+    return jax.devices()
+
+
+def cpu(device_id=0):
+    """Return a CPU context (reference: context.py:90)."""
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator context; on this framework 'gpu' means a TPU chip."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """Return a TPU context."""
+    return Context("tpu", device_id)
+
+
+def num_devices(device_type="tpu"):
+    import jax
+
+    if device_type in ("cpu", "cpu_pinned"):
+        try:
+            return len(jax.devices("cpu"))
+        except RuntimeError:
+            return len(jax.devices())
+    return len(_accelerator_devices())
+
+
+def current_context():
+    """The default context (reference: context.py:103)."""
+    if not hasattr(Context._state, "stack") or not Context._state.stack:
+        return Context("cpu", 0)
+    return Context._state.stack[-1]

@@ -1,0 +1,7 @@
+"""Contrib namespace (reference: python/mxnet/contrib/).
+
+`contrib.autograd` re-exports the core tape (the reference keeps autograd in
+contrib at v0.9.5); detection/CTC ops register via `mxnet_tpu.contrib.ops`.
+"""
+from .. import autograd  # contrib.autograd API lives in core autograd
+from . import tensorboard

@@ -1,0 +1,331 @@
+"""Executor — binds a Symbol to devices and runs it.
+
+TPU-native re-design of GraphExecutor (`src/executor/graph_executor.cc`) and
+`python/mxnet/executor.py`.  Where the reference runs a hand-built pipeline
+(Gradient pass → PlaceDevice → InferShape → PlanMemory → per-node engine
+ops), here the whole graph lowers into ONE jitted XLA program:
+
+* forward  = jit(run_graph)                          — XLA fuses + plans memory
+* backward = jit(vjp(run_graph)) w.r.t. grad-args    — the nnvm Gradient pass
+* bulk-exec segments (graph_executor.cc:678) are implicit: the entire
+  program is a single segment.
+* grad_req add/write = functional accumulate, write-back into grad buffers.
+* data-parallelism lives one level up: executor_group device_puts the batch
+  with a mesh NamedSharding and replicates params, and jit propagates those
+  committed input shardings — XLA inserts the psum collectives that the
+  reference's KVStore Reduce performed.  The executor itself is
+  sharding-agnostic.
+
+Training forward runs the combined (outputs, grads, new_aux) program with
+ones head-gradients — loss heads carry custom_vjp so this reproduces the
+reference's Backward() semantics; ``backward(out_grads)`` with explicit head
+gradients re-runs the combined program with those cotangents.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .registry import OpContext
+from . import ndarray as nd
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        # -- argument arrays
+        if isinstance(args, dict):
+            self.arg_dict = {n: args[n] for n in arg_names}
+        else:
+            if len(args) != len(arg_names):
+                raise MXNetError("Length of args does not match arguments: %s"
+                                 % arg_names)
+            self.arg_dict = dict(zip(arg_names, args))
+        self.arg_arrays = [self.arg_dict[n] for n in arg_names]
+
+        # -- gradient request
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self.grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        # -- gradient arrays
+        if args_grad is None:
+            self.grad_dict = {}
+        elif isinstance(args_grad, dict):
+            self.grad_dict = dict(args_grad)
+        else:
+            self.grad_dict = {n: g for n, g in zip(arg_names, args_grad)
+                              if g is not None}
+        for n in arg_names:
+            if self.grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                self.grad_req[n] = "null"
+        self.grad_arrays = [self.grad_dict.get(n) for n in arg_names]
+
+        # -- aux arrays
+        if aux_states is None:
+            aux_states = {}
+        if isinstance(aux_states, dict):
+            self.aux_dict = {n: aux_states[n] for n in aux_names}
+        else:
+            self.aux_dict = dict(zip(aux_names, aux_states))
+        self.aux_arrays = [self.aux_dict[n] for n in aux_names]
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._grad_names = [n for n in arg_names
+                            if self.grad_req.get(n, "null") != "null"]
+        self._outputs = None
+        self._cached_grads = None
+        self._fn_cache = {}
+        self.outputs_ready = False
+
+    # ------------------------------------------------------------------
+    # graph execution as a pure function
+    # ------------------------------------------------------------------
+    def _run_graph(self, env_args, env_aux, rng, is_train):
+        """Topologically execute the node DAG on jnp values."""
+        import jax
+
+        sym = self._symbol
+        values = {}
+        new_aux = dict(env_aux)
+        for seq, node in enumerate(sym._topo()):
+            if node.is_variable:
+                if node.is_aux_var:
+                    values[(id(node), 0)] = env_aux[node.name]
+                else:
+                    values[(id(node), 0)] = env_args[node.name]
+                continue
+            attrs = node.parsed_attrs()
+            n_args = node.op.n_inputs(attrs)
+            ins = [values[(id(s), i)] for s, i in node.inputs[:n_args]]
+            aux_ins = [values[(id(s), i)] for s, i in node.inputs[n_args:]]
+            octx = OpContext(is_train=is_train,
+                             rng=jax.random.fold_in(rng, seq) if rng is not None else None)
+            outs, node_new_aux = node.op.fcompute(attrs, ins, aux_ins, octx)
+            for i, o in enumerate(outs):
+                values[(id(node), i)] = o
+            for (anode, _), val in zip(node.inputs[n_args:], node_new_aux):
+                new_aux[anode.name] = val
+        outputs = [values[(id(n), i)] for n, i in sym._outputs]
+        return outputs, new_aux
+
+    def _get_fn(self, kind):
+        """kind: 'fwd_test' | 'fwd_train' | 'combined'"""
+        fn = self._fn_cache.get(kind)
+        if fn is not None:
+            return fn
+        import jax
+
+        grad_names = self._grad_names
+        arg_names = self._arg_names
+        aux_names = self._aux_names
+        reqs = self.grad_req
+
+        if kind in ("fwd_test", "fwd_train"):
+            is_train = kind == "fwd_train"
+
+            def run(arg_vals, aux_vals, rng):
+                env_args = dict(zip(arg_names, arg_vals))
+                env_aux = dict(zip(aux_names, aux_vals))
+                outs, new_aux = self._run_graph(env_args, env_aux, rng, is_train)
+                return outs, [new_aux[n] for n in aux_names]
+
+            fn = jax.jit(run)
+        else:
+            def combined(arg_vals, aux_vals, old_grads, head_grads, rng):
+                env_aux_in = dict(zip(aux_names, aux_vals))
+                nograd = {n: v for n, v in zip(arg_names, arg_vals)
+                          if n not in set(grad_names)}
+
+                def fwd(gvals):
+                    env_args = dict(nograd)
+                    env_args.update(zip(grad_names, gvals))
+                    outs, new_aux = self._run_graph(env_args, env_aux_in, rng, True)
+                    return outs, [new_aux[n] for n in aux_names]
+
+                gvals = [v for n, v in zip(arg_names, arg_vals) if n in set(grad_names)]
+                outs, vjp_fn, new_aux = jax.vjp(fwd, gvals, has_aux=True)
+                if head_grads is None:
+                    import jax.numpy as jnp
+
+                    cts = [jnp.ones_like(o) for o in outs]
+                else:
+                    cts = list(head_grads)
+                (grads,) = vjp_fn(cts)
+                out_grads = []
+                for gname, g in zip(grad_names, grads):
+                    if reqs[gname] == "add":
+                        out_grads.append(old_grads[grad_names.index(gname)] + g)
+                    else:
+                        out_grads.append(g)
+                return outs, new_aux, out_grads
+
+            fn = jax.jit(combined)
+        self._fn_cache[kind] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # public API (reference: python/mxnet/executor.py)
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        import jax
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("Unknown argument %s" % k)
+            self.arg_dict[k]._set_data(
+                v.data if isinstance(v, nd.NDArray) else v)
+
+        arg_vals = [self.arg_dict[n].data for n in self._arg_names]
+        aux_vals = [self.aux_dict[n].data for n in self._aux_names]
+        from . import random as _rnd
+
+        rng = _rnd.split_key()
+        self._last_rng = rng  # reused by backward(out_grads): same dropout masks
+
+        if is_train and self._grad_names:
+            fn = self._get_fn("combined")
+            old_grads = [self.grad_dict[n].data for n in self._grad_names]
+            outs, new_aux, grads = fn(arg_vals, aux_vals, old_grads, None, rng)
+            self._cached_grads = grads
+        else:
+            fn = self._get_fn("fwd_train" if is_train else "fwd_test")
+            outs, new_aux = fn(arg_vals, aux_vals, rng)
+            self._cached_grads = None
+        for n, v in zip(self._aux_names, new_aux):
+            self.aux_dict[n]._set_data(v)
+        self._outputs = [nd.NDArray(o, self._ctx) for o in outs]
+        self.outputs_ready = True
+        if self._monitor_callback is not None:
+            for name, arr in zip(self._symbol.list_outputs(), self._outputs):
+                self._monitor_callback(name, arr)
+        return self._outputs
+
+    def backward(self, out_grads=None):
+        if not self._grad_names:
+            return
+        if out_grads is not None:
+            if isinstance(out_grads, nd.NDArray):
+                out_grads = [out_grads]
+            import jax
+
+            arg_vals = [self.arg_dict[n].data for n in self._arg_names]
+            aux_vals = [self.aux_dict[n].data for n in self._aux_names]
+            old_grads = [self.grad_dict[n].data for n in self._grad_names]
+            # reuse the forward pass's key so stochastic ops (Dropout) apply
+            # the same mask the caller's observed outputs came from
+            rng = getattr(self, "_last_rng", None)
+            if rng is None:
+                from . import random as _rnd
+
+                rng = _rnd.split_key()
+            fn = self._get_fn("combined")
+            outs, new_aux, grads = fn(arg_vals, aux_vals, old_grads,
+                                      [g.data for g in out_grads], rng)
+        else:
+            if self._cached_grads is None:
+                raise MXNetError(
+                    "backward() called before forward(is_train=True)")
+            grads = self._cached_grads
+        for n, g in zip(self._grad_names, grads):
+            self.grad_dict[n]._set_data(g.astype(self.grad_dict[n].data.dtype))
+        self._cached_grads = None
+
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            raise MXNetError("Executor has not been run")
+        return self._outputs
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in arguments" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    array.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("Found name %r not in aux states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes; jit specializes per shape the same
+        way bucketing shares memory pools in the reference."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args, new_grads = {}, {}
+        for name, shape, arr in zip(self._arg_names, arg_shapes, self.arg_arrays):
+            if tuple(shape) == arr.shape:
+                new_args[name] = arr
+                if name in self.grad_dict:
+                    new_grads[name] = self.grad_dict[name]
+            else:
+                new_args[name] = nd.zeros(shape, self._ctx, dtype=arr.dtype)
+                if name in self.grad_dict:
+                    new_grads[name] = nd.zeros(shape, self._ctx, dtype=arr.dtype)
+        new_aux = {}
+        for name, shape, arr in zip(self._aux_names, aux_shapes, self.aux_arrays):
+            new_aux[name] = arr if tuple(shape) == arr.shape else \
+                nd.zeros(shape, self._ctx, dtype=arr.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self.grad_req, new_aux, group2ctx=self._group2ctx)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("Cannot infer shapes with inputs %s" % kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        grads = {}
+        if isinstance(grad_req, str):
+            req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            req = dict(zip(arg_names, grad_req))
+        else:
+            req = {n: grad_req.get(n, "null") for n in arg_names}
+        for name, shape in zip(arg_names, arg_shapes):
+            dtype = type_dict.get(name, np.float32)
+            # reuse shared executor buffers when shapes match (bucketing)
+            if shared_exec is not None and name in shared_exec.arg_dict and \
+                    shared_exec.arg_dict[name].shape == tuple(shape):
+                args[name] = shared_exec.arg_dict[name]
+                if name in shared_exec.grad_dict and req.get(name, "null") != "null":
+                    grads[name] = shared_exec.grad_dict[name]
+                    continue
+            else:
+                args[name] = nd.zeros(shape, ctx, dtype=dtype)
+            if req.get(name, "null") != "null":
+                grads[name] = nd.zeros(shape, ctx, dtype=dtype)
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            dtype = type_dict.get(name, np.float32)
+            if shared_exec is not None and name in shared_exec.aux_dict and \
+                    shared_exec.aux_dict[name].shape == tuple(shape):
+                aux[name] = shared_exec.aux_dict[name]
+            else:
+                aux[name] = nd.zeros(shape, ctx, dtype=dtype)
+        return Executor(symbol, ctx, args, grads, req, aux, group2ctx=group2ctx)

@@ -1,0 +1,433 @@
+"""Image pipeline: record-backed and list-backed image iterators + augmenters.
+
+Reference: `src/io/iter_image_recordio.cc` (threaded decode + augment chain)
+and `python/mxnet/image.py` (pure-python pipeline).  TPU-native: numpy
+augmenters on a host worker thread (PrefetchingIter) feeding device batches;
+JPEG decode uses cv2 when present, else the raw-array codec from recordio.
+A C++ reader for the hot path lives in src/ (native runtime).
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+from . import io as io_mod
+from . import ndarray as nd
+from . import recordio
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "random_size_crop",
+           "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+           "RandomOrderAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
+           "ImageRecordIter"]
+
+
+def _cv2():
+    try:
+        import cv2
+
+        return cv2
+    except ImportError:
+        return None
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an image buffer to HWC uint8 numpy (reference: image.py:32)."""
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+        if img is None:
+            raise MXNetError("imdecode failed")
+        if to_rgb:
+            img = img[:, :, ::-1]
+        return img
+    raise MXNetError("imdecode requires cv2; use raw-array records instead")
+
+
+def _resize(img, w, h, interp=1):
+    cv2 = _cv2()
+    if cv2 is not None:
+        return cv2.resize(img, (w, h), interpolation=interp)
+    # nearest-neighbor fallback
+    ys = (np.arange(h) * img.shape[0] / h).astype(np.int64)
+    xs = (np.arange(w) * img.shape[1] / w).astype(np.int64)
+    return img[ys][:, xs]
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return _resize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(np.float32) - mean
+    if std is not None:
+        src /= std
+    return src
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    h, w = src.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = pyrandom.uniform(*ratio)
+        new_w = int(round(np.sqrt(new_area * new_ratio)))
+        new_h = int(round(np.sqrt(new_area / new_ratio)))
+        if pyrandom.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# -- augmenter functors (reference: image_aug_default.cc chain) -------------
+
+def ResizeAug(size, interp=2):
+    def aug(src):
+        return [resize_short(src, size, interp)]
+
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomOrderAug(ts):
+    def aug(src):
+        srcs = [src]
+        pyrandom.shuffle(ts)
+        for t in ts:
+            srcs = [j for i in srcs for j in t(i)]
+        return srcs
+
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    ts = []
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    if brightness > 0:
+        def baug(src):
+            alpha = 1.0 + pyrandom.uniform(-brightness, brightness)
+            return [src * alpha]
+
+        ts.append(baug)
+    if contrast > 0:
+        def caug(src):
+            alpha = 1.0 + pyrandom.uniform(-contrast, contrast)
+            gray = src * coef
+            gray = (3.0 * (1.0 - alpha) / gray.size) * np.sum(gray)
+            return [src * alpha + gray]
+
+        ts.append(caug)
+    if saturation > 0:
+        def saug(src):
+            alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
+            gray = np.sum(src * coef, axis=2, keepdims=True)
+            return [src * alpha + gray * (1.0 - alpha)]
+
+        ts.append(saug)
+    return RandomOrderAug(ts)
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(eigvec * alpha, eigval)
+        return [src + rgb]
+
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return [color_normalize(src, mean, std)]
+
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if pyrandom.random() < p:
+            src = src[:, ::-1]
+        return [src]
+
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [src.astype(np.float32)]
+
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Standard augmenter chain (reference: image.py:170)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and getattr(mean, "shape", None):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over .rec files or image lists (reference: image.py:247)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+
+        self.imglist = None
+        if path_imglist:
+            imglist = {}
+            imgkeys = []
+            with open(path_imglist) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]], dtype=np.float32)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+            self.imglist = imglist
+            self.seq = imgkeys
+        elif isinstance(imglist, list):
+            result = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                result[key] = (np.array(img[:-1], dtype=np.float32), img[-1])
+                imgkeys.append(str(key))
+            self.imglist = result
+            self.seq = imgkeys
+        else:
+            self.seq = self.imgidx
+
+        self.path_root = path_root
+        self.provide_data = [DataDesc(data_name, (batch_size,) + tuple(data_shape))]
+        if label_width > 1:
+            self.provide_label = [DataDesc(label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if num_parts > 1 and self.seq is not None:
+            part = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * part:(part_index + 1) * part]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast", "saturation",
+                         "pca_noise", "inter_method")})
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as fin:
+                img = fin.read()
+            return label, img
+        else:
+            s = self.imgrec.read()
+            if s is None:
+                raise StopIteration
+            header, img = recordio.unpack(s)
+            return header.label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
+        batch_label = np.zeros((batch_size,) if self.label_width == 1
+                               else (batch_size, self.label_width),
+                               dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                if isinstance(s, bytes):
+                    try:
+                        data = [imdecode(s)]
+                    except MXNetError:
+                        _, data_arr = recordio.unpack_img(
+                            recordio.pack(recordio.IRHeader(0, label, 0, 0), s))
+                        data = [data_arr]
+                else:
+                    data = [s]
+                if data[0].ndim == 2:
+                    data = [np.broadcast_to(d[:, :, None], d.shape + (c,))
+                            for d in data]
+                for aug in self.auglist:
+                    data = [ret for src in data for ret in aug(src)]
+                for d in data:
+                    if i >= batch_size:
+                        break
+                    if d.shape[:2] != (h, w):
+                        d = _resize(d.astype(np.float32), w, h)
+                    batch_data[i] = d
+                    batch_label[i] = label
+                    i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        # HWC -> CHW
+        batch_data = np.transpose(batch_data, (0, 3, 1, 2))
+        return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
+                         pad=batch_size - i)
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
+                    shuffle=False, mean_r=0, mean_g=0, mean_b=0,
+                    std_r=1, std_g=1, std_b=1, rand_crop=False,
+                    rand_mirror=False, preprocess_threads=4, num_parts=1,
+                    part_index=0, path_imgidx=None, prefetch_buffer=4,
+                    **kwargs):
+    """RecordIO image iterator (reference: iter_image_recordio.cc), assembled
+    from ImageIter + PrefetchingIter (threaded decode analog)."""
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b])
+    std = None
+    if (std_r, std_g, std_b) != (1, 1, 1):
+        std = np.array([std_r, std_g, std_b])
+    aug_kwargs = {k: v for k, v in kwargs.items()
+                  if k in ("resize", "rand_resize", "brightness", "contrast",
+                           "saturation", "pca_noise", "inter_method")}
+    it = ImageIter(batch_size=batch_size, data_shape=data_shape,
+                   path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                   shuffle=shuffle, rand_crop=rand_crop, rand_mirror=rand_mirror,
+                   mean=mean, std=std, num_parts=num_parts,
+                   part_index=part_index, **aug_kwargs)
+    return io_mod.PrefetchingIter(it, capacity=prefetch_buffer)

@@ -1,0 +1,468 @@
+"""Data iterators.
+
+Reference: `src/io/` + `python/mxnet/io.py` — IIterator registry, MNISTIter,
+ImageRecordIter, CSVIter, batching/prefetch composition layers.  TPU-native:
+host-side numpy pipelines feeding device batches; PrefetchingIter
+double-buffers on a worker thread (the dmlc::ThreadedIter analog,
+`src/io/iter_prefetcher.h:28`).  The heavy RecordIO/image path lives in
+`recordio.py` / `image.py` with a C++ accelerated reader in src/ (native
+runtime).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+import queue as _queue
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray, array
+
+__all__ = ["DataBatch", "DataIter", "DataDesc", "NDArrayIter", "MNISTIter",
+           "CSVIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
+
+
+class DataDesc:
+    """Named shape/dtype descriptor (reference: io.py DataDesc namedtuple)."""
+
+    def __init__(self, name, shape, dtype=np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __iter__(self):
+        # unpacks like the (name, shape) tuple the reference uses
+        yield self.name
+        yield self.shape
+
+    def __getitem__(self, i):
+        return (self.name, self.shape)[i]
+
+    def __len__(self):
+        return 2
+
+    def __eq__(self, other):
+        if isinstance(other, (tuple, list)):
+            return (self.name, self.shape) == tuple(other)
+        return (self.name, self.shape) == (other.name, other.shape)
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+
+class DataBatch:
+    """One mini-batch (reference: io.py:66)."""
+
+    def __init__(self, data, label=None, pad=0, index=None, bucket_key=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (reference: io.py:92)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py:130-385)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        assert self.num_data >= batch_size, "batch_size needs to be smaller than data size."
+
+        if shuffle:
+            idx = np.arange(self.num_data)
+            np.random.shuffle(idx)
+            self.data = [(k, v[idx]) for k, v in self.data]
+            self.label = [(k, v[idx]) for k, v in self.label]
+
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.data = [(k, v[:new_n]) for k, v in self.data]
+            self.label = [(k, v[:new_n]) for k, v in self.label]
+            self.num_data = new_n
+
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.cursor = -batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [array(x[1][self.cursor:self.cursor + self.batch_size])
+                    for x in data_source]
+        pad = self.batch_size - self.num_data + self.cursor
+        return [array(np.concatenate((x[1][self.cursor:], x[1][:pad]), axis=0))
+                for x in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, numpy) (reference: io.py:456)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them "
+                        "or dict with them as values")
+    ret = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        ret.append((k, np.asarray(v)))
+    return ret
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format reader (reference: src/io/iter_mnist.cc:61-241).
+
+    If the idx files are absent, generates a deterministic synthetic
+    class-conditional digit dataset of the same shape so examples and tests
+    run hermetically (clearly a deviation: the reference requires the files).
+    """
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, silent=False, seed=0,
+                 input_shape=None, num_parts=1, part_index=0, **kwargs):
+        super().__init__(batch_size)
+        if os.path.exists(image) or os.path.exists(image + ".gz"):
+            images = _read_idx(image)
+            labels = _read_idx(label)
+        else:
+            images, labels = _synthetic_mnist(seed=seed)
+        images = images.astype(np.float32) / 255.0
+        if num_parts > 1:
+            part = len(images) // num_parts
+            images = images[part_index * part:(part_index + 1) * part]
+            labels = labels[part_index * part:(part_index + 1) * part]
+        if flat:
+            images = images.reshape(len(images), -1)
+        else:
+            images = images.reshape(len(images), 1, 28, 28)
+        if input_shape is not None:
+            images = images.reshape((len(images),) + tuple(input_shape))
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            idx = rng.permutation(len(images))
+            images, labels = images[idx], labels[idx]
+        self._inner = NDArrayIter(images, labels.astype(np.float32),
+                                  batch_size=batch_size, shuffle=False,
+                                  last_batch_handle="discard",
+                                  data_name="data", label_name="softmax_label")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+def _read_idx(path):
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        opener = lambda: gzip.open(path + ".gz", "rb")
+    else:
+        opener = lambda: open(path, "rb")
+    with opener() as f:
+        magic = struct.unpack(">i", f.read(4))[0]
+        ndim = magic % 256
+        shape = tuple(struct.unpack(">i", f.read(4))[0] for _ in range(ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _synthetic_mnist(n=6000, seed=0):
+    """Deterministic class-conditional digit-like dataset (28x28, 10 classes).
+    Class prototypes are fixed across seeds so train/val splits share the
+    task; the seed only varies the samples drawn."""
+    protos = np.random.RandomState(42).uniform(0, 255, size=(10, 28, 28)) \
+        .astype(np.float32)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.uint8)
+    noise = rng.normal(0, 16.0, size=(n, 28, 28)).astype(np.float32)
+    images = np.clip(protos[labels] * 0.7 + noise, 0, 255).astype(np.uint8)
+    return images, labels
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((len(data),), dtype=np.float32)
+        self._inner = NDArrayIter(
+            data, label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard",
+            data_name="data", label_name="label")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class ResizeIter(DataIter):
+    """Resize any iterator to a fixed number of batches per epoch
+    (reference: io.py:388)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference: io.py:529 + iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue = _queue.Queue(maxsize=capacity)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r[x.name], str) else r[x.name]
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r[x.name], str) else r[x.name]
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                batches = [i.next() for i in self.iters]
+            except StopIteration:
+                self._queue.put(None)
+                return
+            self._queue.put(batches)
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def __del__(self):
+        self._stop.set()
+
+    def reset(self):
+        # drain
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=0.01)
+        for i in self.iters:
+            i.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=self._queue.maxsize)
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        if self.n_iter == 1:
+            return batches[0]
+        return DataBatch(data=sum([b.data for b in batches], []),
+                         label=sum([b.label for b in batches], []),
+                         pad=batches[0].pad)
+
+
+def ImageRecordIter(**kwargs):
+    """RecordIO image pipeline (reference: src/io/iter_image_recordio.cc).
+
+    Provided by `mxnet_tpu.image` (python + native reader); this forwarding
+    keeps the reference's `mx.io.ImageRecordIter` name working.
+    """
+    from . import image
+
+    return image.ImageRecordIter(**kwargs)
+
+
+def MXDataIter(*args, **kwargs):
+    raise MXNetError("MXDataIter wraps the legacy C iterator handles; use the "
+                     "named iterators (MNISTIter, ImageRecordIter, CSVIter) directly")

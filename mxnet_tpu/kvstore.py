@@ -1,0 +1,174 @@
+"""KVStore — the data-parallel communication facade.
+
+Reference: `src/kvstore/` + `python/mxnet/kvstore.py`.  The reference's
+two-level stack (intra-node Comm reduce `src/kvstore/comm.h` + inter-node
+ps-lite parameter server `src/kvstore/kvstore_dist.h`) collapses on TPU into
+XLA collectives over the ICI mesh (SURVEY §5): gradients produced by a
+mesh-sharded executor arrive **already all-reduced**, so `local`/`device`
+push/pull degenerate to "apply optimizer, serve copies" — the same contract
+`KVStoreLocal` exposes (`kvstore_local.h:22-127`), at ICI speed.
+
+Multi-host (`dist_sync` / `dist_device_sync`): when `jax.distributed` is
+initialized (the `tools/launch.py` analog is `mxnet_tpu.parallel.launch`),
+push performs a cross-process psum over a global device mesh; `dist_async`
+has no sane XLA analog and is accepted as an alias of `dist_sync` with a
+logged deviation (SURVEY §7d).
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _ensure_list(keys, vals):
+    if isinstance(keys, (int, str)):
+        return [keys], [vals]
+    assert len(keys) == len(vals)
+    return list(keys), list(vals)
+
+
+class KVStore:
+    """Key-value store for parameter synchronization (reference: kvstore.py:60)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _ensure_list(key, value)
+        for k, v in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("Key %s already initialized" % str(k))
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        """Reduce value(s) into the stored weight; run updater if set.
+
+        With a mesh-sharded executor the per-device grads are already
+        globally summed by XLA psum, so `value` is typically a single
+        array — matching reference semantics where Comm::Reduce has run.
+        """
+        keys, vals = _ensure_list(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                merged = v[0]
+                for other in v[1:]:
+                    merged = merged + other.as_in_context(merged.context)
+            else:
+                merged = v
+            merged = self._allreduce(merged)
+            if self._updater is not None:
+                self._updater(self._str_to_int(k), merged, self._store[k])
+            else:
+                self._store[k]._set_data(merged.data.astype(self._store[k].dtype))
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = _ensure_list(key, out)
+        for k, o in zip(keys, outs):
+            if isinstance(o, (list, tuple)):
+                for dst in o:
+                    self._store[k].copyto(dst)
+            else:
+                self._store[k].copyto(o)
+
+    def _allreduce(self, arr):
+        """Cross-process sum when running multi-host."""
+        import jax
+
+        if jax.process_count() == 1 or self._type.startswith("local") \
+                or self._type == "device":
+            return arr
+        from .parallel import collectives
+
+        return NDArray(collectives.global_sum(arr.data), arr.context)
+
+    @staticmethod
+    def _str_to_int(k):
+        return k if isinstance(k, int) else abs(hash(k)) % (1 << 31)
+
+    # -- updater / optimizer ----------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """Install optimizer server-side (reference pickles it to the PS,
+        kvstore.py:226; here the 'server' is this process)."""
+        if self._type.startswith("dist"):
+            # exercise the pickle path for parity with the reference protocol
+            optimizer = pickle.loads(pickle.dumps(optimizer))
+        from .optimizer import get_updater
+
+        self._optimizer = optimizer
+        self.set_updater(get_updater(optimizer))
+
+    # -- cluster topology --------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        import jax
+
+        return jax.process_index() if self._type.startswith("dist") else 0
+
+    @property
+    def num_workers(self):
+        import jax
+
+        return jax.process_count() if self._type.startswith("dist") else 1
+
+    def barrier(self):
+        if self.num_workers > 1:
+            from .parallel import collectives
+
+            collectives.barrier()
+
+    _barrier = barrier
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def send_command_to_servers(self, head, body):
+        logging.debug("kvstore command %s ignored (no parameter server on TPU)", head)
+
+    _send_command_to_servers = send_command_to_servers
+
+
+def create(name="local"):
+    """Create a KVStore (reference: kvstore.py:373).
+
+    Types: local | device | dist_sync | dist_device_sync | dist_async.
+    On TPU `local` and `device` are the same store (XLA collectives do the
+    reduce); `dist_async` degrades to sync with a warning.
+    """
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name not in ("local", "device", "local_allreduce_cpu",
+                    "local_allreduce_device", "dist_sync", "dist_device_sync",
+                    "dist_async", "dist"):
+        raise MXNetError("Unknown KVStore type %s" % name)
+    if name == "dist_async":
+        logging.warning("dist_async has no XLA analog; using synchronous "
+                        "all-reduce semantics (documented deviation)")
+    return KVStore(name)

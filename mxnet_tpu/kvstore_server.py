@@ -1,0 +1,35 @@
+"""KVStore server bootstrap (reference: python/mxnet/kvstore_server.py).
+
+The reference launches dedicated parameter-server processes
+(`DMLC_ROLE=server`) running a command loop with a pickled optimizer.  On
+TPU there is no parameter server: synchronization is XLA collectives inside
+the compiled step, and every process is a worker.  This module keeps the
+entry point so reference launch scripts don't crash: a 'server' role simply
+idles until the workers finish (join barrier), which we implement as a
+no-op return.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def run(self):
+        logging.info("mxnet_tpu: parameter-server role is subsumed by XLA "
+                     "collectives; server process exiting cleanly")
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server":
+        from . import kvstore
+
+        server = KVStoreServer(kvstore.create("dist"))
+        server.run()

@@ -1,0 +1,16 @@
+"""Model zoo — symbol generators for the reference's example networks
+(reference: example/image-classification/symbol_*.py, example/rnn)."""
+from . import resnet
+from . import lenet
+from . import mlp
+from . import alexnet
+from . import vgg
+from . import inception_bn
+from . import lstm_lm
+
+get_lenet = lenet.get_symbol
+get_mlp = mlp.get_symbol
+get_resnet = resnet.get_symbol
+get_alexnet = alexnet.get_symbol
+get_vgg = vgg.get_symbol
+get_inception_bn = inception_bn.get_symbol

@@ -1,0 +1,39 @@
+"""LSTM language model for bucketed training.
+
+Reference: example/rnn/lstm_bucketing.py — the PTB workload (SURVEY §6
+configs): embedding → stacked LSTM (fused) → FC over vocab → SoftmaxOutput,
+returned as a sym_gen for BucketingModule.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+from .. import rnn
+
+
+def sym_gen_factory(num_hidden=200, num_layers=2, num_embed=200,
+                    vocab_size=10000, fused=True, dropout=0.0):
+    """Returns sym_gen(seq_len) for BucketingModule (layout NT)."""
+
+    if fused:
+        stack = rnn.FusedRNNCell(num_hidden, num_layers=num_layers,
+                                 mode="lstm", prefix="lstm_", dropout=dropout)
+    else:
+        stack = rnn.SequentialRNNCell()
+        for i in range(num_layers):
+            stack.add(rnn.LSTMCell(num_hidden, prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab_size, output_dim=num_embed,
+                              name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                                       merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        lab = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    return sym_gen, stack

@@ -1,0 +1,9 @@
+"""Module API (reference: python/mxnet/module/)."""
+from .base_module import BaseModule, BatchEndParam
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["BaseModule", "BatchEndParam", "Module", "BucketingModule",
+           "SequentialModule", "DataParallelExecutorGroup"]

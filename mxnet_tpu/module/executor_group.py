@@ -1,0 +1,233 @@
+"""DataParallelExecutorGroup — data parallelism over a device mesh.
+
+Reference: `python/mxnet/module/executor_group.py` (651 LoC): one executor
+per device, batch sliced along axis 0 (`decide_slices`:207), gradients
+reduced through KVStore.  TPU-native re-design: ONE executor jitted over a
+``jax.sharding.Mesh`` whose 'data' axis spans the bound contexts; the batch
+is device_put with a NamedSharding on axis 0 and parameters are replicated.
+XLA's SPMD partitioner then inserts the psum collectives over ICI that the
+reference's Comm::Reduce/Broadcast performed explicitly — gradients arrive
+at `update()` already globally summed.
+
+Note one intentional deviation: BatchNorm statistics are computed over the
+global (mesh-wide) batch, i.e. sync-BN, where the reference normalizes
+per-device (SURVEY §7f).  For contexts==1 they coincide.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..executor import Executor
+from ..io import DataDesc
+
+
+def _as_desc_list(shapes):
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], s[1]
+            out.append(DataDesc(name, shape))
+    return out
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=logging, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.logger = logger
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.data_shapes = _as_desc_list(data_shapes)
+        self.label_shapes = _as_desc_list(label_shapes) if label_shapes else []
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [d.name for d in self.label_shapes]
+
+        self.batch_size = self.data_shapes[0].shape[0]
+        if self.batch_size % max(1, len(contexts)) != 0:
+            raise MXNetError("batch size %d must be divisible by the number of "
+                             "contexts %d" % (self.batch_size, len(contexts)))
+
+        # gradient requests
+        if isinstance(grad_req, str):
+            base_req = grad_req
+        else:
+            base_req = None
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                req = (base_req or (grad_req.get(name, "write")
+                                    if isinstance(grad_req, dict) else "write"))
+                if not for_training or name in self.fixed_param_names:
+                    req = "null"
+            elif name in self.data_names:
+                req = "write" if (for_training and inputs_need_grad) else "null"
+            else:
+                req = "null"
+            self.grad_req[name] = req
+
+        self._mesh = None
+        self._data_sharding = None
+        self._rep_sharding = None
+        if len(contexts) > 1:
+            self._build_mesh()
+
+        self._bind_exec(shared_group)
+
+    # ------------------------------------------------------------------
+    def _build_mesh(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devices = [c.jax_device for c in self.contexts]
+        if len(set(devices)) != len(devices):
+            # fake multi-context on one physical device (reference test trick):
+            # fall back to single-device execution, semantics unchanged
+            self.logger.debug("contexts map to %d physical device(s); running "
+                              "unsharded", len(set(devices)))
+            return
+        self._mesh = Mesh(np.array(devices), ("data",))
+        self._data_sharding = NamedSharding(self._mesh, P("data"))
+        self._rep_sharding = NamedSharding(self._mesh, P())
+
+    def _place(self, arr, sharded):
+        """device_put an NDArray's buffer onto the bound device(s): mesh
+        NamedSharding when data-parallel, else the single bound device (so a
+        host-built batch moves to TPU).  No-op when already placed."""
+        import jax
+
+        if self._mesh is None:
+            target = self.contexts[0].jax_device
+        else:
+            target = self._data_sharding if sharded else self._rep_sharding
+        arr._set_data(jax.device_put(arr.data, target))
+        return arr
+
+    # ------------------------------------------------------------------
+    def _bind_exec(self, shared_group):
+        kwargs = {d.name: d.shape for d in self.data_shapes + self.label_shapes}
+        type_dict = {d.name: d.dtype for d in self.data_shapes + self.label_shapes}
+        shared_exec = shared_group.execs[0] if shared_group is not None else None
+        ctx = self.contexts[0]
+        exec_ = Executor.simple_bind(self.symbol, ctx, grad_req=self.grad_req,
+                                     type_dict=type_dict, shared_exec=shared_exec,
+                                     **kwargs)
+        # replicate params over the mesh, shard data args
+        for name, arr in exec_.arg_dict.items():
+            self._place(arr, sharded=name in self.data_names or name in self.label_names)
+        for arr in exec_.aux_dict.values():
+            self._place(arr, sharded=False)
+        for arr in exec_.grad_dict.values():
+            self._place(arr, sharded=False)
+        self.execs = [exec_]
+        self.exec_ = exec_
+        self.data_arrays = [exec_.arg_dict[n] for n in self.data_names]
+        self.label_arrays = [exec_.arg_dict[n] for n in self.label_names
+                             if n in exec_.arg_dict]
+        self.param_arrays = [exec_.arg_dict[n] for n in self.param_names]
+        self.grad_arrays = [exec_.grad_dict.get(n) for n in self.param_names]
+        self.aux_arrays = [exec_.aux_dict[n] for n in self.aux_names]
+        self.input_grad_arrays = [exec_.grad_dict.get(n) for n in self.data_names] \
+            if self.inputs_need_grad else []
+
+    # ------------------------------------------------------------------
+    def reshape(self, data_shapes, label_shapes):
+        if _as_desc_list(data_shapes) == self.data_shapes and \
+                _as_desc_list(label_shapes or []) == self.label_shapes:
+            return
+
+        # share the old executor so parameter buffers (same shapes) carry
+        # over — only shape-changed inputs/outputs are reallocated
+        class _Shared:
+            pass
+
+        shared = _Shared()
+        shared.execs = list(self.execs)
+        self.__init__(self.symbol, self.contexts, None, data_shapes, label_shapes,
+                      self.param_names, self.for_training, self.inputs_need_grad,
+                      shared_group=shared,
+                      fixed_param_names=self.fixed_param_names,
+                      grad_req=self.grad_req)
+
+    def set_params(self, arg_params, aux_params):
+        for name, arr in arg_params.items():
+            if name in self.exec_.arg_dict:
+                arr.copyto(self.exec_.arg_dict[name])
+                self._place(self.exec_.arg_dict[name], sharded=False)
+        for name, arr in (aux_params or {}).items():
+            if name in self.exec_.aux_dict:
+                arr.copyto(self.exec_.aux_dict[name])
+                self._place(self.exec_.aux_dict[name], sharded=False)
+
+    def get_params(self, arg_params, aux_params):
+        for name in self.param_names:
+            self.exec_.arg_dict[name].copyto(arg_params[name])
+        for name in self.aux_names:
+            self.exec_.aux_dict[name].copyto(aux_params[name])
+
+    # ------------------------------------------------------------------
+    def load_data_batch(self, data_batch):
+        for name, arr in zip(self.data_names, data_batch.data):
+            dst = self.exec_.arg_dict[name]
+            dst._set_data(arr.data.astype(dst.dtype) if arr.dtype != dst.dtype
+                          else arr.data)
+            self._place(dst, sharded=True)
+        if self.label_names and data_batch.label:
+            for name, arr in zip(self.label_names, data_batch.label):
+                if name in self.exec_.arg_dict:
+                    dst = self.exec_.arg_dict[name]
+                    dst._set_data(arr.data.astype(dst.dtype)
+                                  if arr.dtype != dst.dtype else arr.data)
+                    self._place(dst, sharded=True)
+
+    def _ensure_placement(self):
+        """Re-pin params/grads/aux to the mesh (replicated).  Eager optimizer
+        updates and kvstore pulls commit results to a single device; this
+        restores the mesh sharding before the next compiled step.  device_put
+        with an unchanged sharding is a no-op, so the steady-state cost is
+        nil."""
+        if self._mesh is None:
+            return
+        for arr in self.param_arrays + self.aux_arrays:
+            self._place(arr, sharded=False)
+        for arr in self.grad_arrays + self.input_grad_arrays:
+            if arr is not None:
+                self._place(arr, sharded=False)
+
+    def forward(self, data_batch, is_train=None):
+        self.load_data_batch(data_batch)
+        self._ensure_placement()
+        if is_train is None:
+            is_train = self.for_training
+        self.exec_.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        self.exec_.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self.exec_.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self.exec_.grad_dict[n] for n in self.data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)

@@ -1,0 +1,273 @@
+"""Elementwise unary, binary, scalar, and logic operators.
+
+Covers the reference's `src/operator/tensor/elemwise_unary_op.cc` (68 regs),
+`elemwise_binary_op*.cc`, `elemwise_binary_broadcast_op*.cc`,
+`elemwise_scalar_op*.cc`, and `elemwise_sum.cc`.  One table-driven
+registration per family; compute bodies are jax.numpy — XLA fuses chains of
+these into single kernels, which is the TPU-native replacement for mshadow
+expression templates.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..attrs import Param, ParamSchema
+from ..registry import OpDef, register_op, simple_compute
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _erf(x):
+    import jax
+
+    return jax.scipy.special.erf(x)
+
+
+def _gamma(x):
+    import jax
+
+    return jax.numpy.exp(jax.scipy.special.gammaln(x))
+
+
+def _softrelu(x):
+    jnp = _jnp()
+    return jnp.logaddexp(x, 0.0)
+
+
+def _unary_table():
+    jnp = _jnp()
+    import jax
+
+    return {
+        "abs": jnp.abs,
+        "sign": jnp.sign,
+        "rint": jnp.rint,
+        "ceil": jnp.ceil,
+        "floor": jnp.floor,
+        "trunc": jnp.trunc,
+        "fix": jnp.trunc,
+        "round": jnp.round,
+        "square": jnp.square,
+        "sqrt": jnp.sqrt,
+        "rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+        "cbrt": jnp.cbrt,
+        "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+        "exp": jnp.exp,
+        "log": jnp.log,
+        "log10": jnp.log10,
+        "log2": jnp.log2,
+        "log1p": jnp.log1p,
+        "expm1": jnp.expm1,
+        "sin": jnp.sin,
+        "cos": jnp.cos,
+        "tan": jnp.tan,
+        "arcsin": jnp.arcsin,
+        "arccos": jnp.arccos,
+        "arctan": jnp.arctan,
+        "sinh": jnp.sinh,
+        "cosh": jnp.cosh,
+        "tanh": jnp.tanh,
+        "arcsinh": jnp.arcsinh,
+        "arccosh": jnp.arccosh,
+        "arctanh": jnp.arctanh,
+        "degrees": jnp.degrees,
+        "radians": jnp.radians,
+        "gamma": _gamma,
+        "gammaln": lambda x: jax.scipy.special.gammaln(x),
+        "erf": _erf,
+        "negative": jnp.negative,
+        "reciprocal": lambda x: 1.0 / x,
+        "sigmoid": jax.nn.sigmoid,
+        "relu": lambda x: jnp.maximum(x, 0),
+        "softsign": lambda x: x / (1.0 + jnp.abs(x)),
+        "softrelu": _softrelu,
+        "logical_not": lambda x: (x == 0).astype(x.dtype),
+    }
+
+
+def register_all():
+    jnp = _jnp()
+
+    for name, fn in _unary_table().items():
+        register_op(
+            OpDef(name, simple_compute(lambda attrs, x, f=fn: f(x)), num_inputs=1,
+                  doc="Elementwise %s." % name)
+        )
+
+    # identity-style ops
+    register_op(
+        OpDef("_copy", simple_compute(lambda attrs, x: x + 0), num_inputs=1),
+        aliases=["identity"],
+    )
+    register_op(
+        OpDef(
+            "_identity_with_attr_like_rhs",
+            simple_compute(lambda attrs, lhs, rhs: lhs),
+            num_inputs=2,
+            visible=False,
+        )
+    )
+
+    def _cast(attrs, x):
+        dt = attrs["dtype"]
+        if dt == "bfloat16":
+            return x.astype(jnp.bfloat16)
+        return x.astype(np.dtype(dt))
+
+    register_op(
+        OpDef("Cast", simple_compute(_cast),
+              schema=ParamSchema(Param("dtype", str, required=True)),
+              num_inputs=1, hint="cast"),
+        aliases=["cast"],
+    )
+
+    # -- binary elementwise (broadcast-capable, superset of reference _plus) --
+    def binary_table():
+        import jax
+
+        def fmod(a, b):
+            return a - jnp.trunc(a / b) * b
+
+        return {
+            "plus": jnp.add,
+            "minus": jnp.subtract,
+            "mul": jnp.multiply,
+            "div": jnp.divide,
+            "mod": fmod,
+            "power": jnp.power,
+            "maximum": jnp.maximum,
+            "minimum": jnp.minimum,
+            "hypot": jnp.hypot,
+        }
+
+    for name, fn in binary_table().items():
+        # elemwise form: _plus / _minus / ... (reference elemwise_binary_op.cc)
+        register_op(
+            OpDef("_" + name, simple_compute(lambda attrs, a, b, f=fn: f(a, b)),
+                  num_inputs=2, hint=name),
+            aliases=["_" + {"plus": "add", "minus": "sub"}.get(name, name)]
+            if name in ("plus", "minus") else [],
+        )
+        # broadcast form: broadcast_add / broadcast_plus ...
+        main = "broadcast_" + {"plus": "add", "minus": "sub", "mul": "mul",
+                               "div": "div"}.get(name, name)
+        ali = ["broadcast_" + name] if main != "broadcast_" + name else []
+        register_op(
+            OpDef(main, simple_compute(lambda attrs, a, b, f=fn: f(a, b)),
+                  num_inputs=2, hint=main),
+            aliases=ali,
+        )
+        # scalar forms: _plus_scalar, _rminus_scalar, ...
+        sschema = ParamSchema(Param("scalar", float, required=True))
+        register_op(
+            OpDef("_%s_scalar" % name,
+                  simple_compute(lambda attrs, a, f=fn: f(a, jnp.asarray(attrs["scalar"], a.dtype))),
+                  schema=sschema, num_inputs=1, hint=name)
+        )
+        if name in ("minus", "div", "power", "mod"):
+            register_op(
+                OpDef("_r%s_scalar" % name,
+                      simple_compute(
+                          lambda attrs, a, f=fn: f(jnp.asarray(attrs["scalar"], a.dtype), a)),
+                      schema=sschema, num_inputs=1, hint=name)
+            )
+
+    # comparison / logic (return 0/1 in the input dtype, as the reference does)
+    def logic_table():
+        return {
+            "equal": jnp.equal,
+            "not_equal": jnp.not_equal,
+            "greater": jnp.greater,
+            "greater_equal": jnp.greater_equal,
+            "lesser": jnp.less,
+            "lesser_equal": jnp.less_equal,
+        }
+
+    for name, fn in logic_table().items():
+        register_op(
+            OpDef("broadcast_" + name,
+                  simple_compute(lambda attrs, a, b, f=fn: f(a, b).astype(a.dtype)),
+                  num_inputs=2, hint=name),
+            aliases=["_" + name],
+        )
+        register_op(
+            OpDef("_%s_scalar" % name,
+                  simple_compute(lambda attrs, a, f=fn: f(a, attrs["scalar"]).astype(a.dtype)),
+                  schema=ParamSchema(Param("scalar", float, required=True)),
+                  num_inputs=1, hint=name)
+        )
+
+    # smooth_l1 (reference: elemwise_unary_op.cc smooth_l1 w/ scalar sigma)
+    def _smooth_l1(attrs, x):
+        import jax
+
+        s2 = float(attrs.get("scalar", 1.0)) ** 2
+
+        @jax.custom_jvp
+        def f(v):
+            av = jnp.abs(v)
+            return jnp.where(av < 1.0 / s2, 0.5 * s2 * v * v, av - 0.5 / s2)
+
+        @f.defjvp
+        def f_jvp(primals, tangents):
+            (v,), (dv,) = primals, tangents
+            g = jnp.where(jnp.abs(v) < 1.0 / s2, s2 * v, jnp.sign(v))
+            return f(v), g * dv
+
+        return f(x)
+
+    register_op(
+        OpDef("smooth_l1", simple_compute(_smooth_l1),
+              schema=ParamSchema(Param("scalar", float, default=1.0)), num_inputs=1)
+    )
+
+    # add_n / ElementWiseSum: variadic sum (reference: elemwise_sum.cc)
+    def _add_n(attrs, *xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+    nargs_schema = ParamSchema(Param("num_args", int, required=True))
+    register_op(
+        OpDef("add_n", simple_compute(_add_n), schema=nargs_schema,
+              num_inputs=lambda attrs: attrs["num_args"],
+              arguments=lambda attrs: ["arg%d" % i for i in range(attrs["num_args"])],
+              key_var_num_args="num_args", hint="add_n"),
+        aliases=["ElementWiseSum", "_sum", "elemwise_sum"],
+    )
+
+    # BlockGrad / stop_gradient
+    def _block_grad(attrs, x):
+        import jax
+
+        return jax.lax.stop_gradient(x)
+
+    register_op(OpDef("BlockGrad", simple_compute(_block_grad), num_inputs=1,
+                      hint="blockgrad"), aliases=["stop_gradient"])
+
+    # clip
+    def _clip(attrs, x):
+        return jnp.clip(x, attrs["a_min"], attrs["a_max"])
+
+    register_op(
+        OpDef("clip", simple_compute(_clip),
+              schema=ParamSchema(Param("a_min", float, required=True),
+                                 Param("a_max", float, required=True)),
+              num_inputs=1)
+    )
+
+    # _maximum/_minimum scalar already above via table; mod handled too
+    # _grad_add: used by executor for gradient accumulation
+    register_op(
+        OpDef("_grad_add", simple_compute(lambda attrs, a, b: a + b), num_inputs=2,
+              visible=False)
+    )
+
+
+def register_op_with_aliases(opdef, aliases):
+    register_op(opdef, aliases)

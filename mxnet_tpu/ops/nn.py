@@ -1,0 +1,730 @@
+"""Neural-network layer operators.
+
+TPU-native equivalents of the reference's legacy `OperatorProperty` layer ops
+(`src/operator/*-inl.h`): Convolution (reference builds im2col+dot,
+`src/operator/convolution-inl.h:90-288` — here a single
+`lax.conv_general_dilated`, which XLA tiles straight onto the MXU),
+FullyConnected, Pooling, BatchNorm, Dropout, activations, normalizations,
+loss-output heads, sequence ops.
+
+Loss heads (SoftmaxOutput etc.) install ``jax.custom_vjp`` so that executor
+backward == plain vjp with ones head-gradient reproduces the reference's
+special backward semantics (softmax-minus-label, ignore_label, grad
+normalization — `src/operator/softmax_output-inl.h`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..attrs import Param, ParamSchema
+from ..registry import OpDef, register_op, simple_compute
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _pair(v, n=2):
+    if isinstance(v, int):
+        return (v,) * n
+    if len(v) == 1:
+        return tuple(v) * n
+    return tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# shape inference helpers
+# ---------------------------------------------------------------------------
+
+def _fc_shape(attrs, in_shapes, aux_shapes):
+    dshape = in_shapes[0]
+    nh = attrs["num_hidden"]
+    flat = attrs.get("flatten", True)
+    if flat:
+        d = 1
+        for s in dshape[1:]:
+            d *= s
+        wshape = (nh, d)
+        out = (dshape[0], nh)
+    else:
+        wshape = (nh, dshape[-1])
+        out = tuple(dshape[:-1]) + (nh,)
+    shapes = [dshape, wshape]
+    if not attrs.get("no_bias", False):
+        shapes.append((nh,))
+    return shapes, [out], []
+
+
+def _conv_shape(attrs, in_shapes, aux_shapes):
+    dshape = in_shapes[0]
+    n, c, h, w = dshape
+    kh, kw = _pair(attrs["kernel"])
+    sh, sw = _pair(attrs.get("stride", (1, 1)))
+    ph, pw = _pair(attrs.get("pad", (0, 0)))
+    dh, dw = _pair(attrs.get("dilate", (1, 1)))
+    nf = attrs["num_filter"]
+    ng = attrs.get("num_group", 1)
+    wshape = (nf, c // ng, kh, kw)
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    shapes = [dshape, wshape]
+    if not attrs.get("no_bias", False):
+        shapes.append((nf,))
+    return shapes, [(n, nf, oh, ow)], []
+
+
+def _deconv_shape(attrs, in_shapes, aux_shapes):
+    dshape = in_shapes[0]
+    n, c, h, w = dshape
+    kh, kw = _pair(attrs["kernel"])
+    sh, sw = _pair(attrs.get("stride", (1, 1)))
+    ph, pw = _pair(attrs.get("pad", (0, 0)))
+    ah, aw = _pair(attrs.get("adj", (0, 0)))
+    nf = attrs["num_filter"]
+    ng = attrs.get("num_group", 1)
+    wshape = (c, nf // ng, kh, kw)
+    oh = (h - 1) * sh - 2 * ph + kh + ah
+    ow = (w - 1) * sw - 2 * pw + kw + aw
+    shapes = [dshape, wshape]
+    if not attrs.get("no_bias", True):
+        shapes.append((nf,))
+    return shapes, [(n, nf, oh, ow)], []
+
+
+def _bn_shape(attrs, in_shapes, aux_shapes):
+    dshape = in_shapes[0]
+    c = dshape[1]
+    return [dshape, (c,), (c,)], [dshape, (c,), (c,)], [(c,), (c,)]
+
+
+def register_all():
+    jnp = _jnp()
+    import jax
+    from jax import lax
+
+    # ---------------- Activation ----------------
+    def _activation(attrs, x):
+        act = attrs.get("act_type", "relu")
+        if act == "relu":
+            return jnp.maximum(x, 0)
+        if act == "sigmoid":
+            return jax.nn.sigmoid(x)
+        if act == "tanh":
+            return jnp.tanh(x)
+        if act == "softrelu":
+            return jnp.logaddexp(x, 0.0)
+        if act == "softsign":
+            return x / (1 + jnp.abs(x))
+        raise ValueError("unknown act_type %s" % act)
+
+    register_op(OpDef("Activation", simple_compute(_activation),
+                      schema=ParamSchema(Param("act_type", str, required=True,
+                                               enum=("relu", "sigmoid", "tanh",
+                                                     "softrelu", "softsign"))),
+                      num_inputs=1, hint="activation"))
+
+    def _leaky_relu(attrs, x, *rest):
+        act = attrs.get("act_type", "leaky")
+        slope = attrs.get("slope", 0.25)
+        if act == "leaky" or act == "rrelu":
+            return jnp.where(x > 0, x, slope * x)
+        if act == "elu":
+            return jnp.where(x > 0, x, slope * (jnp.exp(x) - 1))
+        if act == "prelu":
+            gamma = rest[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+            return jnp.where(x > 0, x, gamma * x)
+        raise ValueError(act)
+
+    def _lrelu_shape(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if attrs.get("act_type", "leaky") == "prelu":
+            return [d, (d[1],)], [d], []
+        return [d], [d], []
+
+    register_op(OpDef(
+        "LeakyReLU", simple_compute(_leaky_relu),
+        schema=ParamSchema(
+            Param("act_type", str, default="leaky"),
+            Param("slope", float, default=0.25),
+            Param("lower_bound", float, default=0.125),
+            Param("upper_bound", float, default=0.334)),
+        num_inputs=lambda a: 2 if a.get("act_type") == "prelu" else 1,
+        arguments=lambda a: ["data", "gamma"] if a.get("act_type") == "prelu" else ["data"],
+        infer_shape=_lrelu_shape, hint="leakyrelu"))
+
+    def _softmax_act(attrs, x):
+        if attrs.get("mode", "instance") == "channel":
+            return jax.nn.softmax(x, axis=1)
+        return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+    register_op(OpDef("SoftmaxActivation", simple_compute(_softmax_act),
+                      schema=ParamSchema(Param("mode", str, default="instance")),
+                      num_inputs=1, hint="softmaxactivation"))
+
+    # ---------------- FullyConnected ----------------
+    def _fc(attrs, data, weight, *bias):
+        if attrs.get("flatten", True):
+            x = data.reshape(data.shape[0], -1)
+        else:
+            x = data
+        out = jnp.dot(x, weight.T)
+        if bias:
+            out = out + bias[0]
+        return out
+
+    fc_schema = ParamSchema(Param("num_hidden", int, required=True),
+                            Param("no_bias", bool, default=False),
+                            Param("flatten", bool, default=True))
+    register_op(OpDef(
+        "FullyConnected", simple_compute(_fc), schema=fc_schema,
+        num_inputs=lambda a: 2 if a.get("no_bias") else 3,
+        arguments=lambda a: ["data", "weight"] if a.get("no_bias")
+        else ["data", "weight", "bias"],
+        infer_shape=_fc_shape, hint="fullyconnected"))
+
+    # ---------------- Convolution ----------------
+    conv_schema = ParamSchema(
+        Param("kernel", "shape", required=True),
+        Param("stride", "shape", default=(1, 1)),
+        Param("dilate", "shape", default=(1, 1)),
+        Param("pad", "shape", default=(0, 0)),
+        Param("num_filter", int, required=True),
+        Param("num_group", int, default=1),
+        Param("workspace", int, default=1024),
+        Param("no_bias", bool, default=False),
+        Param("cudnn_tune", str, default=None),
+        Param("cudnn_off", bool, default=False),
+        Param("layout", str, default=None))
+
+    def _conv(attrs, data, weight, *bias):
+        sh, sw = _pair(attrs.get("stride", (1, 1)))
+        ph, pw = _pair(attrs.get("pad", (0, 0)))
+        dh, dw = _pair(attrs.get("dilate", (1, 1)))
+        ng = attrs.get("num_group", 1)
+        out = lax.conv_general_dilated(
+            data, weight, window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=ng,
+            preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None)
+        if bias:
+            out = out + bias[0].reshape(1, -1, 1, 1)
+        return out.astype(data.dtype)
+
+    register_op(OpDef(
+        "Convolution", simple_compute(_conv), schema=conv_schema,
+        num_inputs=lambda a: 2 if a.get("no_bias") else 3,
+        arguments=lambda a: ["data", "weight"] if a.get("no_bias")
+        else ["data", "weight", "bias"],
+        infer_shape=_conv_shape, hint="convolution"))
+
+    # ---------------- Deconvolution ----------------
+    deconv_schema = ParamSchema(
+        Param("kernel", "shape", required=True),
+        Param("stride", "shape", default=(1, 1)),
+        Param("pad", "shape", default=(0, 0)),
+        Param("adj", "shape", default=(0, 0)),
+        Param("target_shape", "shape", default=()),
+        Param("num_filter", int, required=True),
+        Param("num_group", int, default=1),
+        Param("workspace", int, default=512),
+        Param("no_bias", bool, default=True),
+        Param("cudnn_tune", str, default=None),
+        Param("cudnn_off", bool, default=False),
+        Param("layout", str, default=None))
+
+    def _deconv(attrs, data, weight, *bias):
+        kh, kw = _pair(attrs["kernel"])
+        sh, sw = _pair(attrs.get("stride", (1, 1)))
+        ph, pw = _pair(attrs.get("pad", (0, 0)))
+        ah, aw = _pair(attrs.get("adj", (0, 0)))
+        ng = attrs.get("num_group", 1)
+        # deconv = gradient of conv: dilate lhs by stride, full-minus-pad padding,
+        # kernel flipped spatially and IO-transposed (weight is (C, F/g, kh, kw))
+        w = jnp.flip(weight, axis=(-2, -1))
+        if ng > 1:
+            c, fpg = w.shape[0], w.shape[1]
+            w = w.reshape(ng, c // ng, fpg, kh, kw)
+            w = jnp.moveaxis(w, 2, 1).reshape(ng * fpg, c // ng, kh, kw)
+        else:
+            w = jnp.swapaxes(w, 0, 1)
+        out = lax.conv_general_dilated(
+            data, w, window_strides=(1, 1),
+            padding=((kh - 1 - ph, kh - 1 - ph + ah), (kw - 1 - pw, kw - 1 - pw + aw)),
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=ng)
+        if bias:
+            out = out + bias[0].reshape(1, -1, 1, 1)
+        return out.astype(data.dtype)
+
+    register_op(OpDef(
+        "Deconvolution", simple_compute(_deconv), schema=deconv_schema,
+        num_inputs=lambda a: 2 if a.get("no_bias", True) else 3,
+        arguments=lambda a: ["data", "weight"] if a.get("no_bias", True)
+        else ["data", "weight", "bias"],
+        infer_shape=_deconv_shape, hint="deconvolution"))
+
+    # ---------------- Pooling ----------------
+    pool_schema = ParamSchema(
+        Param("kernel", "shape", required=True),
+        Param("pool_type", str, default="max", enum=("max", "avg", "sum")),
+        Param("global_pool", bool, default=False),
+        Param("pooling_convention", str, default="valid"),
+        Param("stride", "shape", default=(1, 1)),
+        Param("pad", "shape", default=(0, 0)))
+
+    def _pool_geometry(attrs, h, w):
+        kh, kw = _pair(attrs["kernel"])
+        sh, sw = _pair(attrs.get("stride", (1, 1)))
+        ph, pw = _pair(attrs.get("pad", (0, 0)))
+        if attrs.get("global_pool", False):
+            return (h, w), (1, 1), (0, 0, 0, 0), (1, 1)
+        if attrs.get("pooling_convention", "valid") == "full":
+            oh = int(np.ceil((h + 2 * ph - kh) / sh)) + 1
+            ow = int(np.ceil((w + 2 * pw - kw) / sw)) + 1
+        else:
+            oh = (h + 2 * ph - kh) // sh + 1
+            ow = (w + 2 * pw - kw) // sw + 1
+        eh = max(0, (oh - 1) * sh + kh - h - 2 * ph)
+        ew = max(0, (ow - 1) * sw + kw - w - 2 * pw)
+        return (kh, kw), (sh, sw), (ph, ph + eh, pw, pw + ew), (oh, ow)
+
+    def _pooling(attrs, x):
+        n, c, h, w = x.shape
+        (kh, kw), (sh, sw), (plo_h, phi_h, plo_w, phi_w), _ = _pool_geometry(attrs, h, w)
+        ptype = attrs.get("pool_type", "max")
+        pads = ((0, 0), (0, 0), (plo_h, phi_h), (plo_w, phi_w))
+        window = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        if ptype == "max":
+            init = -np.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else np.iinfo(np.dtype(x.dtype)).min
+            return lax.reduce_window(x, init, lax.max, window, strides, pads)
+        out = lax.reduce_window(x, 0.0 if jnp.issubdtype(x.dtype, jnp.floating)
+                                else 0, lax.add, window, strides, pads)
+        if ptype == "avg":
+            out = out / (kh * kw)
+        return out
+
+    register_op(OpDef("Pooling", simple_compute(_pooling), schema=pool_schema,
+                      num_inputs=1, hint="pooling"))
+
+    # ---------------- BatchNorm ----------------
+    bn_schema = ParamSchema(
+        Param("eps", float, default=1e-3),
+        Param("momentum", float, default=0.9),
+        Param("fix_gamma", bool, default=True),
+        Param("use_global_stats", bool, default=False),
+        Param("output_mean_var", bool, default=False))
+
+    def _batchnorm(attrs, inputs, aux, octx):
+        data, gamma, beta = inputs
+        moving_mean, moving_var = aux
+        eps = attrs.get("eps", 1e-3)
+        momentum = attrs.get("momentum", 0.9)
+        caxis = 1 if data.ndim > 1 else 0
+        red = tuple(i for i in range(data.ndim) if i != caxis)
+        bshape = tuple(data.shape[caxis] if i == caxis else 1 for i in range(data.ndim))
+        if attrs.get("fix_gamma", True):
+            gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+        use_global = attrs.get("use_global_stats", False) or not octx.is_train
+        if use_global:
+            mean, var = moving_mean, moving_var
+            new_mm, new_mv = moving_mean, moving_var
+        else:
+            mean = jnp.mean(data, axis=red)
+            var = jnp.var(data, axis=red)
+            new_mm = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
+            new_mv = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
+        inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+        out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+        return [out, mean, var], [new_mm, new_mv]
+
+    register_op(OpDef(
+        "BatchNorm", _batchnorm, schema=bn_schema,
+        num_inputs=3, num_outputs=3,
+        num_visible_outputs=lambda a: 3 if a.get("output_mean_var") else 1,
+        arguments=["data", "gamma", "beta"],
+        outputs=["output", "mean", "var"],
+        aux=["moving_mean", "moving_var"],
+        infer_shape=_bn_shape, needs_train=True, hint="batchnorm"))
+
+    # ---------------- Dropout ----------------
+    def _dropout(attrs, inputs, aux, octx):
+        (x,) = inputs
+        p = attrs.get("p", 0.5)
+        if not octx.is_train or p <= 0.0:
+            return [x, jnp.ones_like(x)], []
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(octx.rng, keep, x.shape).astype(x.dtype) / keep
+        return [x * mask, mask], []
+
+    register_op(OpDef(
+        "Dropout", _dropout,
+        schema=ParamSchema(Param("p", float, default=0.5),
+                           Param("mode", str, default="training")),
+        num_inputs=1, num_outputs=2, num_visible_outputs=1,
+        outputs=["output", "mask"],
+        needs_rng=True, needs_train=True, hint="dropout"))
+
+    # ---------------- LRN ----------------
+    def _lrn(attrs, x):
+        n = attrs["nsize"]
+        alpha = attrs.get("alpha", 1e-4)
+        beta = attrs.get("beta", 0.75)
+        knorm = attrs.get("knorm", 2.0)
+        sq = jnp.square(x)
+        half = n // 2
+        padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        win = sum(padded[:, i:i + x.shape[1]] for i in range(n))
+        return x / jnp.power(knorm + (alpha / n) * win, beta)
+
+    register_op(OpDef("LRN", simple_compute(_lrn),
+                      schema=ParamSchema(Param("nsize", int, required=True),
+                                         Param("alpha", float, default=1e-4),
+                                         Param("beta", float, default=0.75),
+                                         Param("knorm", float, default=2.0)),
+                      num_inputs=1, hint="lrn"))
+
+    # ---------------- InstanceNorm ----------------
+    def _instance_norm(attrs, x, gamma, beta):
+        eps = attrs.get("eps", 1e-3)
+        red = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=red, keepdims=True)
+        var = jnp.var(x, axis=red, keepdims=True)
+        g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2))
+        b = beta.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return (x - mean) / jnp.sqrt(var + eps) * g + b
+
+    def _in_shape(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        return [d, (d[1],), (d[1],)], [d], []
+
+    register_op(OpDef("InstanceNorm", simple_compute(_instance_norm),
+                      schema=ParamSchema(Param("eps", float, default=1e-3)),
+                      num_inputs=3, arguments=["data", "gamma", "beta"],
+                      infer_shape=_in_shape, hint="instancenorm"))
+
+    # ---------------- L2Normalization ----------------
+    def _l2norm(attrs, x):
+        eps = attrs.get("eps", 1e-10)
+        mode = attrs.get("mode", "instance")
+        if mode == "instance":
+            red, keep = tuple(range(1, x.ndim)), True
+        elif mode == "channel":
+            red, keep = (1,), True
+        else:  # spatial
+            red, keep = tuple(range(2, x.ndim)), True
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=keep) + eps)
+        return x / norm
+
+    register_op(OpDef("L2Normalization", simple_compute(_l2norm),
+                      schema=ParamSchema(Param("eps", float, default=1e-10),
+                                         Param("mode", str, default="instance")),
+                      num_inputs=1, hint="l2normalization"))
+
+    # ---------------- loss heads ----------------
+    _register_loss_heads()
+
+    # ---------------- Pad ----------------
+    def _pad(attrs, x):
+        pw = attrs["pad_width"]
+        pads = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
+        mode = attrs.get("mode", "constant")
+        if mode == "constant":
+            return jnp.pad(x, pads, constant_values=attrs.get("constant_value", 0.0))
+        return jnp.pad(x, pads, mode="edge" if mode == "edge" else "reflect")
+
+    register_op(OpDef("Pad", simple_compute(_pad),
+                      schema=ParamSchema(Param("mode", str, default="constant"),
+                                         Param("pad_width", "shape", required=True),
+                                         Param("constant_value", float, default=0.0)),
+                      num_inputs=1, hint="pad"),
+                aliases=["pad"])
+
+    # ---------------- UpSampling ----------------
+    def _upsampling(attrs, *xs):
+        scale = attrs["scale"]
+        stype = attrs.get("sample_type", "nearest")
+        x = xs[0]
+        if stype == "nearest":
+            return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        # bilinear: resize (the learnable-deconv variant is Deconvolution-backed)
+        import jax.image
+
+        n, c, h, w = x.shape
+        return jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+
+    register_op(OpDef("UpSampling", simple_compute(_upsampling),
+                      schema=ParamSchema(Param("scale", int, required=True),
+                                         Param("num_filter", int, default=0),
+                                         Param("sample_type", str, default="nearest"),
+                                         Param("multi_input_mode", str, default="concat"),
+                                         Param("num_args", int, default=1),
+                                         Param("workspace", int, default=512)),
+                      num_inputs=lambda a: a.get("num_args", 1),
+                      key_var_num_args="num_args", hint="upsampling"))
+
+    # ---------------- Sequence ops (axis 0 = time, TNC) ----------------
+    def _seq_last(attrs, data, *seq_len):
+        if attrs.get("use_sequence_length", False) and seq_len:
+            idx = (seq_len[0] - 1).astype(jnp.int32)
+            return data[idx, jnp.arange(data.shape[1])]
+        return data[-1]
+
+    seq_schema = ParamSchema(Param("use_sequence_length", bool, default=False),
+                             Param("value", float, default=0.0),
+                             Param("axis", int, default=0))
+
+    def _seq_args(a):
+        return ["data", "sequence_length"] if a.get("use_sequence_length") else ["data"]
+
+    def _seq_n(a):
+        return 2 if a.get("use_sequence_length") else 1
+
+    def _seqlast_shape(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        out = tuple(d[1:])
+        if attrs.get("use_sequence_length"):
+            return [d, (d[1],)], [out], []
+        return [d], [out], []
+
+    register_op(OpDef("SequenceLast", simple_compute(_seq_last), schema=seq_schema,
+                      num_inputs=_seq_n, arguments=_seq_args,
+                      infer_shape=_seqlast_shape, hint="sequencelast"))
+
+    def _seq_mask(attrs, data, *seq_len):
+        if not attrs.get("use_sequence_length", False) or not seq_len:
+            return data
+        T = data.shape[0]
+        mask = jnp.arange(T)[:, None] < seq_len[0][None, :].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+        return jnp.where(mask, data, attrs.get("value", 0.0))
+
+    register_op(OpDef("SequenceMask", simple_compute(_seq_mask), schema=seq_schema,
+                      num_inputs=_seq_n, arguments=_seq_args, hint="sequencemask"))
+
+    def _seq_reverse(attrs, data, *seq_len):
+        if attrs.get("use_sequence_length", False) and seq_len:
+            T = data.shape[0]
+            sl = seq_len[0].astype(jnp.int32)
+            t = jnp.arange(T)[:, None]
+            src = jnp.where(t < sl[None, :], sl[None, :] - 1 - t, t)
+            return jnp.take_along_axis(
+                data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=0)
+        return jnp.flip(data, axis=0)
+
+    register_op(OpDef("SequenceReverse", simple_compute(_seq_reverse), schema=seq_schema,
+                      num_inputs=_seq_n, arguments=_seq_args, hint="sequencereverse"))
+
+    # IdentityAttachKLSparseReg: forward identity (+ sparsity KL penalty on grad)
+    register_op(OpDef("IdentityAttachKLSparseReg",
+                      simple_compute(lambda attrs, x: x + 0),
+                      schema=ParamSchema(Param("sparseness_target", float, default=0.1),
+                                         Param("penalty", float, default=0.001),
+                                         Param("momentum", float, default=0.9)),
+                      num_inputs=1, hint="identityattachklsparsereg"))
+
+
+def _register_loss_heads():
+    import jax
+    import jax.numpy as jnp
+
+    # ---- SoftmaxOutput ----
+    sm_schema = ParamSchema(
+        Param("grad_scale", float, default=1.0),
+        Param("ignore_label", float, default=-1.0),
+        Param("multi_output", bool, default=False),
+        Param("use_ignore", bool, default=False),
+        Param("preserve_shape", bool, default=False),
+        Param("normalization", str, default="null"),
+        Param("out_grad", bool, default=False))
+
+    def _softmax_output(attrs, inputs, aux, octx):
+        data, label = inputs
+        multi = attrs.get("multi_output", False)
+        preserve = attrs.get("preserve_shape", False)
+
+        def fwd_fn(d):
+            if multi:
+                return jax.nn.softmax(d, axis=1)
+            if preserve:
+                return jax.nn.softmax(d, axis=-1)
+            return jax.nn.softmax(d.reshape(d.shape[0], -1), axis=-1).reshape(d.shape)
+
+        @jax.custom_vjp
+        def head(d, l):
+            return fwd_fn(d)
+
+        def head_fwd(d, l):
+            out = fwd_fn(d)
+            return out, (out, l)
+
+        def head_bwd(res, g):
+            out, l = res
+            scale = attrs.get("grad_scale", 1.0)
+            norm = attrs.get("normalization", "null")
+            use_ignore = attrs.get("use_ignore", False)
+            ignore = attrs.get("ignore_label", -1.0)
+            if multi:
+                # data (N, C, ...); label (N, ...)
+                li = l.astype(jnp.int32)
+                onehot = jax.nn.one_hot(li, out.shape[1], dtype=out.dtype, axis=1)
+                grad = out - onehot
+                mask = (l != ignore) if use_ignore else jnp.ones(l.shape, bool)
+                grad = grad * mask[:, None].astype(out.dtype) if use_ignore else grad
+                valid = jnp.sum(mask.astype(out.dtype))
+            else:
+                flat = out.reshape(out.shape[0], -1) if not preserve else out
+                lflat = l.reshape(flat.shape[:-1]).astype(jnp.int32)
+                onehot = jax.nn.one_hot(lflat, flat.shape[-1], dtype=out.dtype)
+                grad = flat - onehot
+                mask = (l.reshape(lflat.shape) != ignore) if use_ignore \
+                    else jnp.ones(lflat.shape, bool)
+                if use_ignore:
+                    grad = grad * mask[..., None].astype(out.dtype)
+                valid = jnp.sum(mask.astype(out.dtype))
+                grad = grad.reshape(out.shape)
+            if norm == "batch":
+                grad = grad / out.shape[0]
+            elif norm == "valid":
+                grad = grad / jnp.maximum(valid, 1.0)
+            return (grad * scale, jnp.zeros_like(l))
+
+        head.defvjp(head_fwd, head_bwd)
+        return [head(data, label)], []
+
+    def _softmax_out_shape(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if attrs.get("multi_output", False):
+            lshape = (d[0],) + tuple(d[2:])
+        elif attrs.get("preserve_shape", False):
+            lshape = tuple(d[:-1])
+        else:
+            lshape = (d[0],)
+        return [d, lshape], [d], []
+
+    register_op(OpDef("SoftmaxOutput", _softmax_output, schema=sm_schema,
+                      num_inputs=2, arguments=["data", "label"],
+                      infer_shape=_softmax_out_shape, hint="softmaxoutput"),
+                aliases=["Softmax"])
+
+    # ---- regression heads ----
+    reg_schema = ParamSchema(Param("grad_scale", float, default=1.0))
+
+    def _make_regression(name, fwd, grad):
+        def fcompute(attrs, inputs, aux, octx):
+            data, label = inputs
+            scale = attrs.get("grad_scale", 1.0)
+
+            @jax.custom_vjp
+            def head(d, l):
+                return fwd(d)
+
+            def head_fwd(d, l):
+                return fwd(d), (fwd(d), l)
+
+            def head_bwd(res, g):
+                out, l = res
+                n = 1
+                for s in out.shape[1:]:
+                    n *= s
+                return (grad(out, l.reshape(out.shape)) * scale / n,
+                        jnp.zeros_like(l))
+
+            head.defvjp(head_fwd, head_bwd)
+            return [head(data, label)], []
+
+        def _reg_shape(attrs, in_shapes, aux_shapes):
+            d = in_shapes[0]
+            return [d, d], [d], []
+
+        register_op(OpDef(name, fcompute, schema=reg_schema, num_inputs=2,
+                          arguments=["data", "label"], infer_shape=_reg_shape,
+                          hint=name.lower()))
+
+    _make_regression("LinearRegressionOutput", lambda d: d, lambda o, l: o - l)
+    _make_regression("LogisticRegressionOutput", lambda d: jax.nn.sigmoid(d),
+                     lambda o, l: o - l)
+    _make_regression("MAERegressionOutput", lambda d: d,
+                     lambda o, l: jnp.sign(o - l))
+
+    # ---- MakeLoss ----
+    ml_schema = ParamSchema(Param("grad_scale", float, default=1.0),
+                            Param("valid_thresh", float, default=0.0),
+                            Param("normalization", str, default="null"))
+
+    def _make_loss(attrs, inputs, aux, octx):
+        (data,) = inputs
+        scale = attrs.get("grad_scale", 1.0)
+        norm = attrs.get("normalization", "null")
+
+        @jax.custom_vjp
+        def head(d):
+            return d
+
+        def head_fwd(d):
+            return d, d
+
+        def head_bwd(d, g):
+            grad = jnp.full_like(d, scale)
+            if norm == "batch":
+                grad = grad / d.shape[0]
+            elif norm == "valid":
+                valid = jnp.sum((d > attrs.get("valid_thresh", 0.0)).astype(d.dtype))
+                grad = grad / jnp.maximum(valid, 1.0)
+            return (grad,)
+
+        head.defvjp(head_fwd, head_bwd)
+        return [head(data)], []
+
+    register_op(OpDef("MakeLoss", _make_loss, schema=ml_schema, num_inputs=1,
+                      hint="makeloss"),
+                aliases=["make_loss"])
+
+    # ---- SVMOutput ----
+    svm_schema = ParamSchema(Param("margin", float, default=1.0),
+                             Param("regularization_coefficient", float, default=1.0),
+                             Param("use_linear", bool, default=False))
+
+    def _svm_output(attrs, inputs, aux, octx):
+        data, label = inputs
+        margin = attrs.get("margin", 1.0)
+        reg = attrs.get("regularization_coefficient", 1.0)
+        linear = attrs.get("use_linear", False)
+
+        @jax.custom_vjp
+        def head(d, l):
+            return d
+
+        def head_fwd(d, l):
+            return d, (d, l)
+
+        def head_bwd(res, g):
+            d, l = res
+            li = l.astype(jnp.int32)
+            onehot = jax.nn.one_hot(li, d.shape[1], dtype=d.dtype)
+            score_y = jnp.take_along_axis(d, li[:, None], axis=1)
+            if linear:  # L1-SVM subgradient
+                viol = ((margin - (2 * onehot - 1) * d) > 0).astype(d.dtype)
+                grad = -(2 * onehot - 1) * viol * reg
+            else:  # L2-SVM
+                m = jnp.maximum(0.0, margin - (2 * onehot - 1) * d)
+                grad = -2.0 * (2 * onehot - 1) * m * reg
+            del score_y
+            return (grad, jnp.zeros_like(l))
+
+        head.defvjp(head_fwd, head_bwd)
+        return [head(data, label)], []
+
+    def _svm_shape(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        return [d, (d[0],)], [d], []
+
+    register_op(OpDef("SVMOutput", _svm_output, schema=svm_schema, num_inputs=2,
+                      arguments=["data", "label"], infer_shape=_svm_shape,
+                      hint="svmoutput"))

@@ -1,0 +1,251 @@
+"""Fused multi-layer RNN operator (LSTM/GRU/vanilla, bidirectional).
+
+Reference: the `RNN` op is cuDNN/MIOpen-only (`src/operator/cudnn_rnn-inl.h:
+22-563`; the CPU body is an empty TODO, `rnn-inl.h:106-135`).  TPU-native:
+`lax.scan` over time per layer/direction — XLA unrolls the cell matmuls onto
+the MXU; there is no vendor-library escape hatch and none is needed.
+
+Flat parameter layout (our packing convention, documented for
+FusedRNNCell.pack/unpack_weights):
+  for layer in 0..L-1: for direction in 0..D-1:
+      W[gates*H, in_size]   (i2h)
+      R[gates*H, H]         (h2h)
+  then for layer: for direction:
+      bW[gates*H]           (i2h bias)
+      bR[gates*H]           (h2h bias)
+with in_size = input_dim at layer 0, else H*D.  Gate order: LSTM i,f,g,o;
+GRU r,z,n (cuDNN order, same as the reference's MIOpen path).
+
+Inputs: data (T,N,I), parameters (flat,), state (L*D,N,H)[, state_cell].
+Outputs: out (T,N,H*D)[, state_out[, statecell_out]] with only `out`
+visible unless state_outputs=True.
+"""
+from __future__ import annotations
+
+from ..attrs import Param, ParamSchema
+from ..registry import OpDef, register_op
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layers, state_size, mode, bidirectional, input_size):
+    """Total flat parameter count (used by shape inference + FusedRNNCell)."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * d
+        size += d * g * state_size * (in_size + state_size)  # W + R
+    size += num_layers * d * 2 * g * state_size              # biases
+    return size
+
+
+def _layout(num_layers, state_size, mode, bidirectional, input_size):
+    """Yield (name, offset, shape) for every packed tensor, in pack order."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    off = 0
+    out = []
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * d
+        for dr in range(d):
+            out.append(("l%d_d%d_i2h_weight" % (layer, dr), off,
+                        (g * state_size, in_size)))
+            off += g * state_size * in_size
+            out.append(("l%d_d%d_h2h_weight" % (layer, dr), off,
+                        (g * state_size, state_size)))
+            off += g * state_size * state_size
+    for layer in range(num_layers):
+        for dr in range(d):
+            out.append(("l%d_d%d_i2h_bias" % (layer, dr), off, (g * state_size,)))
+            off += g * state_size
+            out.append(("l%d_d%d_h2h_bias" % (layer, dr), off, (g * state_size,)))
+            off += g * state_size
+    return out
+
+
+def _cell_step(mode, H):
+    import jax
+    import jax.numpy as jnp
+
+    if mode == "lstm":
+        def step(carry, gates_x, R, bR):
+            h, c = carry
+            gates = gates_x + jnp.dot(h, R.T) + bR
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+    elif mode == "gru":
+        def step(carry, gates_x, R, bR):
+            (h,) = carry
+            gh = jnp.dot(h, R.T) + bR
+            rx, zx, nx = jnp.split(gates_x, 3, axis=-1)
+            rh, zh, nh = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            n = jnp.tanh(nx + r * nh)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else (lambda x: jnp.maximum(x, 0))
+
+        def step(carry, gates_x, R, bR):
+            (h,) = carry
+            h_new = act(gates_x + jnp.dot(h, R.T) + bR)
+            return (h_new,), h_new
+    return step
+
+
+def register_all():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    schema = ParamSchema(
+        Param("state_size", int, required=True),
+        Param("num_layers", int, required=True),
+        Param("bidirectional", bool, default=False),
+        Param("mode", str, required=True,
+              enum=("rnn_relu", "rnn_tanh", "lstm", "gru")),
+        Param("p", float, default=0.0),
+        Param("state_outputs", bool, default=False),
+    )
+
+    def _num_inputs(attrs):
+        return 4 if attrs["mode"] == "lstm" else 3
+
+    def _arguments(attrs):
+        if attrs["mode"] == "lstm":
+            return ["data", "parameters", "state", "state_cell"]
+        return ["data", "parameters", "state"]
+
+    def _num_outputs(attrs):
+        if attrs["mode"] == "lstm":
+            return 3
+        return 2
+
+    def _num_visible(attrs):
+        if not attrs.get("state_outputs", False):
+            return 1
+        return _num_outputs(attrs)
+
+    def _outputs(attrs):
+        if attrs["mode"] == "lstm":
+            return ["output", "state", "state_cell"]
+        return ["output", "state"]
+
+    def _infer_shape(attrs, in_shapes, aux_shapes):
+        T, N, I = in_shapes[0]
+        H = attrs["state_size"]
+        L = attrs["num_layers"]
+        D = 2 if attrs.get("bidirectional", False) else 1
+        psize = rnn_param_size(L, H, attrs["mode"], D == 2, I)
+        state_shape = (L * D, N, H)
+        ins = [in_shapes[0], (psize,), state_shape]
+        outs = [(T, N, H * D), state_shape]
+        if attrs["mode"] == "lstm":
+            ins.append(state_shape)
+            outs.append(state_shape)
+        return ins, outs, []
+
+    def _rnn(attrs, inputs, aux, octx):
+        data, params = inputs[0], inputs[1]
+        state = inputs[2]
+        mode = attrs["mode"]
+        H = attrs["state_size"]
+        L = attrs["num_layers"]
+        D = 2 if attrs.get("bidirectional", False) else 1
+        p_drop = attrs.get("p", 0.0)
+        T, N, I = data.shape
+        state_cell = inputs[3] if mode == "lstm" else None
+
+        layout = {name: (off, shape)
+                  for name, off, shape in _layout(L, H, mode, D == 2, I)}
+
+        def get(name):
+            off, shape = layout[name]
+            n = 1
+            for s in shape:
+                n *= s
+            return params[off:off + n].reshape(shape)
+
+        step = _cell_step(mode, H)
+        x = data
+        out_states_h = []
+        out_states_c = []
+        for layer in range(L):
+            dir_outs = []
+            for dr in range(D):
+                W = get("l%d_d%d_i2h_weight" % (layer, dr))
+                R = get("l%d_d%d_h2h_weight" % (layer, dr))
+                bW = get("l%d_d%d_i2h_bias" % (layer, dr))
+                bR = get("l%d_d%d_h2h_bias" % (layer, dr))
+                h0 = state[layer * D + dr]
+                if mode == "lstm":
+                    c0 = state_cell[layer * D + dr]
+                    carry0 = (h0, c0)
+                else:
+                    carry0 = (h0,)
+                seq = x if dr == 0 else jnp.flip(x, axis=0)
+                # hoist the input matmul out of the scan: one big MXU matmul
+                gates_x = jnp.einsum("tni,gi->tng", seq, W) + bW
+
+                def scan_fn(carry, gx, R=R, bR=bR):
+                    new_carry, h = step(carry, gx, R, bR)
+                    return new_carry, h
+
+                final_carry, hs = lax.scan(scan_fn, carry0, gates_x)
+                if dr == 1:
+                    hs = jnp.flip(hs, axis=0)
+                dir_outs.append(hs)
+                out_states_h.append(final_carry[0])
+                if mode == "lstm":
+                    out_states_c.append(final_carry[1])
+            x = dir_outs[0] if D == 1 else jnp.concatenate(dir_outs, axis=-1)
+            if p_drop > 0.0 and octx.is_train and layer < L - 1:
+                keep = 1.0 - p_drop
+                mask = jax.random.bernoulli(
+                    jax.random.fold_in(octx.rng, layer), keep, x.shape)
+                x = x * mask.astype(x.dtype) / keep
+
+        outs = [x, jnp.stack(out_states_h)]
+        if mode == "lstm":
+            outs.append(jnp.stack(out_states_c))
+        return outs, []
+
+    # internal: zero initial state whose batch dim follows a reference input
+    # (the reference's begin_state(func=sym.zeros) analog, shape-safe under
+    # bucketing where batch is only known at bind)
+    bs_schema = ParamSchema(Param("shape", "shape", required=True),
+                            Param("batch_axis", int, default=0))
+
+    def _begin_state(attrs, ref):
+        shape = tuple(attrs["shape"])
+        n = ref.shape[attrs.get("batch_axis", 0)]
+        shape = tuple(n if s == 0 else s for s in shape)
+        return jnp.zeros(shape, dtype=ref.dtype)
+
+    def _begin_state_shape(attrs, in_shapes, aux_shapes):
+        ref = in_shapes[0]
+        shape = tuple(attrs["shape"])
+        n = ref[attrs.get("batch_axis", 0)]
+        return [ref], [tuple(n if s == 0 else s for s in shape)], []
+
+    from ..registry import simple_compute
+
+    register_op(OpDef("_rnn_begin_state", simple_compute(_begin_state),
+                      schema=bs_schema, num_inputs=1,
+                      infer_shape=_begin_state_shape, hint="begin_state",
+                      visible=False))
+
+    register_op(OpDef("RNN", _rnn, schema=schema,
+                      num_inputs=_num_inputs, num_outputs=_num_outputs,
+                      num_visible_outputs=_num_visible,
+                      arguments=_arguments, outputs=_outputs,
+                      infer_shape=_infer_shape,
+                      needs_rng=True, needs_train=True, hint="rnn"))

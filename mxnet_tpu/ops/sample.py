@@ -1,0 +1,115 @@
+"""Random sampling operators.
+
+Reference: `src/operator/tensor/sample_op.cc` (uniform/normal) and
+`multisample_op.cc` (distribution family).  TPU-native: functional
+``jax.random`` keyed from the global chain (`mxnet_tpu.random`), key passed
+as a traced jit argument.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..attrs import Param, ParamSchema
+from ..registry import OpDef, register_op
+
+
+def _shape_dtype(attrs, jnp):
+    shape = tuple(attrs.get("shape", ()) or ())
+    dt = attrs.get("dtype", "float32") or "float32"
+    return shape, (jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt))
+
+
+def register_all():
+    import jax
+    import jax.numpy as jnp
+
+    base_schema = lambda *extra: ParamSchema(
+        *extra,
+        Param("shape", "shape", default=()),
+        Param("ctx", str, default=""),
+        Param("dtype", str, default="float32"),
+    )
+
+    def _sample_shape(attrs, in_shapes, aux_shapes):
+        return [], [tuple(attrs.get("shape", ()) or ())], []
+
+    def reg(name, fn, schema, aliases=()):
+        def fcompute(attrs, inputs, aux, octx):
+            return [fn(attrs, octx.rng)], []
+
+        register_op(OpDef(name, fcompute, schema=schema, num_inputs=0,
+                          needs_rng=True, infer_shape=_sample_shape,
+                          hint=name.lstrip("_")),
+                    aliases=aliases)
+
+    def _uniform(attrs, key):
+        shape, dt = _shape_dtype(attrs, jnp)
+        return jax.random.uniform(key, shape, minval=attrs.get("low", 0.0),
+                                  maxval=attrs.get("high", 1.0)).astype(dt)
+
+    reg("uniform", _uniform,
+        base_schema(Param("low", float, default=0.0), Param("high", float, default=1.0)),
+        aliases=["_sample_uniform", "random_uniform"])
+
+    def _normal(attrs, key):
+        shape, dt = _shape_dtype(attrs, jnp)
+        loc = attrs.get("loc", 0.0)
+        scale = attrs.get("scale", 1.0)
+        return (jax.random.normal(key, shape) * scale + loc).astype(dt)
+
+    reg("normal", _normal,
+        base_schema(Param("loc", float, default=0.0), Param("scale", float, default=1.0)),
+        aliases=["_sample_normal", "random_normal"])
+
+    def _gamma(attrs, key):
+        shape, dt = _shape_dtype(attrs, jnp)
+        a = attrs.get("alpha", 1.0)
+        b = attrs.get("beta", 1.0)
+        return (jax.random.gamma(key, a, shape) * b).astype(dt)
+
+    reg("_sample_gamma", _gamma,
+        base_schema(Param("alpha", float, default=1.0), Param("beta", float, default=1.0)),
+        aliases=["random_gamma"])
+
+    def _exponential(attrs, key):
+        shape, dt = _shape_dtype(attrs, jnp)
+        lam = attrs.get("lam", 1.0)
+        return (jax.random.exponential(key, shape) / lam).astype(dt)
+
+    reg("_sample_exponential", _exponential,
+        base_schema(Param("lam", float, default=1.0)),
+        aliases=["random_exponential"])
+
+    def _poisson(attrs, key):
+        shape, dt = _shape_dtype(attrs, jnp)
+        lam = attrs.get("lam", 1.0)
+        return jax.random.poisson(key, lam, shape).astype(dt)
+
+    reg("_sample_poisson", _poisson,
+        base_schema(Param("lam", float, default=1.0)),
+        aliases=["random_poisson"])
+
+    def _neg_binomial(attrs, key):
+        shape, dt = _shape_dtype(attrs, jnp)
+        k = attrs.get("k", 1)
+        p = attrs.get("p", 1.0)
+        k1, k2 = jax.random.split(key)
+        lam = jax.random.gamma(k1, k, shape) * (1 - p) / p
+        return jax.random.poisson(k2, lam, shape).astype(dt)
+
+    reg("_sample_negative_binomial", _neg_binomial,
+        base_schema(Param("k", int, default=1), Param("p", float, default=1.0)),
+        aliases=["random_negative_binomial"])
+
+    def _gen_neg_binomial(attrs, key):
+        shape, dt = _shape_dtype(attrs, jnp)
+        mu = attrs.get("mu", 1.0)
+        alpha = attrs.get("alpha", 1.0)
+        k1, k2 = jax.random.split(key)
+        r = 1.0 / alpha
+        lam = jax.random.gamma(k1, r, shape) * (mu * alpha)
+        return jax.random.poisson(k2, lam, shape).astype(dt)
+
+    reg("_sample_generalized_negative_binomial", _gen_neg_binomial,
+        base_schema(Param("mu", float, default=1.0), Param("alpha", float, default=1.0)),
+        aliases=["random_generalized_negative_binomial"])

@@ -1,0 +1,514 @@
+"""Tensor ops: reductions, matrix/shape manipulation, indexing, init,
+ordering, sampling, control flow.
+
+Covers reference `src/operator/tensor/`: broadcast_reduce_op_value.cc,
+matrix_op.cc, indexing_op.cc, init_op.cc, ordering_op.cc, sample_op.cc,
+control_flow_op.cc, loss_binary_op.cc and `src/operator/nn/softmax.cc`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..attrs import Param, ParamSchema
+from ..registry import OpDef, register_op, simple_compute
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _norm_axis(attrs, ndim):
+    """MXNet reduce-axis semantics: axis=() → all axes; exclude inverts."""
+    axis = attrs.get("axis", ())
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if attrs.get("exclude", False):
+        axes = tuple(i for i in range(ndim) if i not in axes)
+    return axes
+
+
+_REDUCE_SCHEMA = ParamSchema(
+    Param("axis", "shape", default=()),
+    Param("keepdims", bool, default=False),
+    Param("exclude", bool, default=False),
+)
+
+
+def register_all():
+    jnp = _jnp()
+    import jax
+
+    # ---------------- reductions ----------------
+    def reduce_table():
+        return {
+            "sum": jnp.sum,
+            "mean": jnp.mean,
+            "prod": jnp.prod,
+            "nansum": jnp.nansum,
+            "nanprod": jnp.nanprod,
+            "max": jnp.max,
+            "min": jnp.min,
+        }
+
+    for name, fn in reduce_table().items():
+        def _red(attrs, x, f=fn):
+            axes = _norm_axis(attrs, x.ndim)
+            return f(x, axis=axes, keepdims=attrs.get("keepdims", False))
+
+        aliases = []
+        if name == "sum":
+            aliases = ["sum_axis"]
+        if name == "max":
+            aliases = ["max_axis"]
+        if name == "min":
+            aliases = ["min_axis"]
+        register_op(OpDef(name, simple_compute(_red), schema=_REDUCE_SCHEMA,
+                          num_inputs=1, hint=name), aliases=aliases)
+
+    def _norm(attrs, x):
+        return jnp.sqrt(jnp.sum(jnp.square(x))).reshape((1,))
+
+    register_op(OpDef("norm", simple_compute(_norm), num_inputs=1))
+
+    arg_schema = ParamSchema(Param("axis", int, default=None),
+                             Param("keepdims", bool, default=False))
+
+    for name, fn in (("argmax", jnp.argmax), ("argmin", jnp.argmin)):
+        def _arg(attrs, x, f=fn):
+            axis = attrs.get("axis", None)
+            out = f(x, axis=axis)
+            if attrs.get("keepdims", False) and axis is not None:
+                out = jnp.expand_dims(out, axis)
+            return out.astype(x.dtype)
+
+        register_op(OpDef(name, simple_compute(_arg), schema=arg_schema, num_inputs=1))
+
+    def _argmax_channel(attrs, x):
+        return jnp.argmax(x, axis=1).astype(x.dtype)
+
+    register_op(OpDef("argmax_channel", simple_compute(_argmax_channel), num_inputs=1))
+
+    # ---------------- broadcast helpers ----------------
+    def _broadcast_to(attrs, x):
+        shape = attrs["shape"]
+        shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+        return jnp.broadcast_to(x, shape)
+
+    register_op(OpDef("broadcast_to", simple_compute(_broadcast_to),
+                      schema=ParamSchema(Param("shape", "shape", required=True)),
+                      num_inputs=1))
+
+    def _broadcast_axis(attrs, x):
+        axes = attrs["axis"]
+        sizes = attrs["size"]
+        if isinstance(axes, int):
+            axes, sizes = (axes,), (sizes,)
+        shape = list(x.shape)
+        for a, s in zip(axes, sizes):
+            shape[a] = s
+        return jnp.broadcast_to(x, tuple(shape))
+
+    register_op(OpDef("broadcast_axis", simple_compute(_broadcast_axis),
+                      schema=ParamSchema(Param("axis", "shape", default=()),
+                                         Param("size", "shape", default=())),
+                      num_inputs=1, hint="broadcast_axis"),
+                aliases=["broadcast_axes"])
+
+    # ---------------- shape manipulation ----------------
+    def _reshape(attrs, x):
+        target = attrs.get("shape", ())
+        if not target and "target_shape" in attrs and attrs.get("target_shape"):
+            target = attrs["target_shape"]
+        out_shape = _infer_reshape(tuple(target), x.shape, attrs.get("reverse", False))
+        return jnp.reshape(x, out_shape)
+
+    register_op(OpDef("Reshape", simple_compute(_reshape),
+                      schema=ParamSchema(Param("shape", "shape", default=()),
+                                         Param("reverse", bool, default=False),
+                                         Param("target_shape", "shape", default=()),
+                                         Param("keep_highest", bool, default=False)),
+                      num_inputs=1, hint="reshape"),
+                aliases=["reshape"])
+
+    register_op(OpDef("Flatten",
+                      simple_compute(lambda attrs, x: jnp.reshape(x, (x.shape[0], -1))),
+                      num_inputs=1, hint="flatten"),
+                aliases=["flatten"])
+
+    def _transpose(attrs, x):
+        axes = attrs.get("axes", ())
+        return jnp.transpose(x, axes if axes else None)
+
+    register_op(OpDef("transpose", simple_compute(_transpose),
+                      schema=ParamSchema(Param("axes", "shape", default=())),
+                      num_inputs=1))
+
+    def _expand_dims(attrs, x):
+        return jnp.expand_dims(x, attrs["axis"])
+
+    register_op(OpDef("expand_dims", simple_compute(_expand_dims),
+                      schema=ParamSchema(Param("axis", int, required=True)),
+                      num_inputs=1))
+
+    def _slice(attrs, x):
+        begin, end = attrs["begin"], attrs["end"]
+        idx = tuple(slice(b, e) for b, e in zip(begin, end))
+        return x[idx]
+
+    register_op(OpDef("slice", simple_compute(_slice),
+                      schema=ParamSchema(Param("begin", "shape", required=True),
+                                         Param("end", "shape", required=True)),
+                      num_inputs=1, hint="slice"),
+                aliases=["crop"])
+
+    def _slice_axis(attrs, x):
+        axis = attrs["axis"] % x.ndim
+        begin = attrs["begin"]
+        end = attrs["end"]
+        if end is None or end == 0 and begin > 0:
+            end = x.shape[axis]
+        if end is not None and end < 0:
+            end = x.shape[axis] + end
+        if begin < 0:
+            begin = x.shape[axis] + begin
+        idx = [slice(None)] * x.ndim
+        idx[axis] = slice(begin, end)
+        return x[tuple(idx)]
+
+    register_op(OpDef("slice_axis", simple_compute(_slice_axis),
+                      schema=ParamSchema(Param("axis", int, required=True),
+                                         Param("begin", int, required=True),
+                                         Param("end", lambda s: None if str(s) == "None" else int(float(s)), default=None)),
+                      num_inputs=1))
+
+    def _dot(attrs, a, b):
+        ta, tb = attrs.get("transpose_a", False), attrs.get("transpose_b", False)
+        if ta:
+            a = jnp.transpose(a)
+        if tb:
+            b = jnp.transpose(b)
+        if a.ndim == 1 and b.ndim == 1:
+            return jnp.dot(a, b).reshape((1,))
+        return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+    dot_schema = ParamSchema(Param("transpose_a", bool, default=False),
+                             Param("transpose_b", bool, default=False))
+    register_op(OpDef("dot", simple_compute(_dot), schema=dot_schema, num_inputs=2))
+
+    def _batch_dot(attrs, a, b):
+        ta, tb = attrs.get("transpose_a", False), attrs.get("transpose_b", False)
+        if ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    register_op(OpDef("batch_dot", simple_compute(_batch_dot), schema=dot_schema,
+                      num_inputs=2))
+
+    def _repeat(attrs, x):
+        return jnp.repeat(x, attrs["repeats"], axis=attrs.get("axis", None))
+
+    register_op(OpDef("repeat", simple_compute(_repeat),
+                      schema=ParamSchema(Param("repeats", int, required=True),
+                                         Param("axis", lambda s: None if str(s) == "None" else int(float(s)), default=None)),
+                      num_inputs=1))
+
+    def _tile(attrs, x):
+        return jnp.tile(x, attrs["reps"])
+
+    register_op(OpDef("tile", simple_compute(_tile),
+                      schema=ParamSchema(Param("reps", "shape", required=True)),
+                      num_inputs=1))
+
+    def _reverse(attrs, x):
+        out = x
+        for a in attrs["axis"]:
+            out = jnp.flip(out, axis=a)
+        return out
+
+    register_op(OpDef("reverse", simple_compute(_reverse),
+                      schema=ParamSchema(Param("axis", "shape", required=True)),
+                      num_inputs=1, hint="reverse"),
+                aliases=["flip"])
+
+    def _swapaxes(attrs, x):
+        return jnp.swapaxes(x, attrs.get("dim1", 0), attrs.get("dim2", 0))
+
+    register_op(OpDef("SwapAxis", simple_compute(_swapaxes),
+                      schema=ParamSchema(Param("dim1", int, default=0),
+                                         Param("dim2", int, default=0)),
+                      num_inputs=1, hint="swapaxis"),
+                aliases=["swapaxes"])
+
+    # Concat (variadic)
+    def _concat(attrs, *xs):
+        return jnp.concatenate(xs, axis=attrs.get("dim", 1))
+
+    concat_schema = ParamSchema(Param("num_args", int, required=True),
+                                Param("dim", int, default=1))
+    register_op(OpDef("Concat", simple_compute(_concat), schema=concat_schema,
+                      num_inputs=lambda a: a["num_args"],
+                      arguments=lambda a: ["arg%d" % i for i in range(a["num_args"])],
+                      key_var_num_args="num_args", hint="concat"),
+                aliases=["concat"])
+
+    # SliceChannel / split (multi-output)
+    def _split(attrs, x):
+        n = attrs["num_outputs"]
+        axis = attrs.get("axis", 1)
+        parts = jnp.split(x, n, axis=axis)
+        if attrs.get("squeeze_axis", False):
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+
+    split_schema = ParamSchema(Param("num_outputs", int, required=True),
+                               Param("axis", int, default=1),
+                               Param("squeeze_axis", bool, default=False))
+    register_op(OpDef("SliceChannel", simple_compute(_split), schema=split_schema,
+                      num_inputs=1, num_outputs=lambda a: a["num_outputs"],
+                      hint="slicechannel"),
+                aliases=["split"])
+
+    # ---------------- indexing ----------------
+    def _embedding(attrs, data, weight):
+        return weight[data.astype(jnp.int32)]
+
+    def _embedding_shape(attrs, in_shapes, aux_shapes):
+        dshape = in_shapes[0]
+        wshape = (attrs["input_dim"], attrs["output_dim"])
+        out = tuple(dshape) + (attrs["output_dim"],)
+        return [dshape, wshape], [out], []
+
+    register_op(OpDef("Embedding", simple_compute(_embedding),
+                      schema=ParamSchema(Param("input_dim", int, required=True),
+                                         Param("output_dim", int, required=True),
+                                         Param("dtype", str, default="float32")),
+                      num_inputs=2, arguments=["data", "weight"],
+                      infer_shape=_embedding_shape, hint="embedding"))
+
+    def _take(attrs, a, indices):
+        return jnp.take(a, indices.astype(jnp.int32), axis=attrs.get("axis", 0),
+                        mode=("clip" if attrs.get("mode", "clip") == "clip" else "wrap"))
+
+    register_op(OpDef("take", simple_compute(_take),
+                      schema=ParamSchema(Param("axis", int, default=0),
+                                         Param("mode", str, default="clip")),
+                      num_inputs=2, arguments=["a", "indices"]))
+
+    def _batch_take(attrs, a, indices):
+        return a[jnp.arange(a.shape[0]), indices.astype(jnp.int32)]
+
+    register_op(OpDef("batch_take", simple_compute(_batch_take), num_inputs=2,
+                      arguments=["a", "indices"]))
+
+    def _one_hot(attrs, indices):
+        import jax
+
+        return jax.nn.one_hot(indices.astype(jnp.int32), attrs["depth"],
+                              dtype=np.dtype(attrs.get("dtype", "float32"))) * \
+            (attrs.get("on_value", 1.0) - attrs.get("off_value", 0.0)) + \
+            attrs.get("off_value", 0.0)
+
+    register_op(OpDef("one_hot", simple_compute(_one_hot),
+                      schema=ParamSchema(Param("depth", int, required=True),
+                                         Param("on_value", float, default=1.0),
+                                         Param("off_value", float, default=0.0),
+                                         Param("dtype", str, default="float32")),
+                      num_inputs=1, arguments=["indices"]))
+
+    def _pick(attrs, data, index):
+        axis = attrs.get("axis", -1)
+        axis = axis % data.ndim
+        idx = index.astype(jnp.int32)
+        picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+        if not attrs.get("keepdims", False):
+            picked = jnp.squeeze(picked, axis=axis)
+        return picked
+
+    register_op(OpDef("pick", simple_compute(_pick),
+                      schema=ParamSchema(Param("axis", int, default=-1),
+                                         Param("keepdims", bool, default=False)),
+                      num_inputs=2, arguments=["data", "index"]))
+
+    # ---------------- init ----------------
+    def _shape_dtype(attrs):
+        shape = attrs.get("shape", ())
+        dt = attrs.get("dtype", "float32") or "float32"
+        return tuple(shape), (jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt))
+
+    init_schema = ParamSchema(Param("shape", "shape", default=()),
+                              Param("ctx", str, default=""),
+                              Param("dtype", str, default="float32"))
+
+    def _zeros_shape(attrs, in_shapes, aux_shapes):
+        return [], [tuple(attrs.get("shape", ()))], []
+
+    register_op(OpDef("_zeros",
+                      simple_compute(lambda attrs: jnp.zeros(*_shape_dtype(attrs))),
+                      schema=init_schema, num_inputs=0, infer_shape=_zeros_shape,
+                      hint="zeros"))
+    register_op(OpDef("_ones",
+                      simple_compute(lambda attrs: jnp.ones(*_shape_dtype(attrs))),
+                      schema=init_schema, num_inputs=0, infer_shape=_zeros_shape,
+                      hint="ones"))
+
+    def _arange_op(attrs):
+        arr = np.arange(attrs["start"], attrs.get("stop", None), attrs.get("step", 1.0))
+        if attrs.get("repeat", 1) != 1:
+            arr = np.repeat(arr, attrs["repeat"])
+        _, dt = _shape_dtype(attrs)
+        return jnp.asarray(arr, dtype=dt)
+
+    register_op(OpDef("_arange", simple_compute(_arange_op),
+                      schema=ParamSchema(Param("start", float, default=0.0),
+                                         Param("stop", lambda s: None if str(s) == "None" else float(s), default=None),
+                                         Param("step", float, default=1.0),
+                                         Param("repeat", int, default=1),
+                                         Param("dtype", str, default="float32")),
+                      num_inputs=0, hint="arange"))
+
+    register_op(OpDef("zeros_like", simple_compute(lambda attrs, x: jnp.zeros_like(x)),
+                      num_inputs=1))
+    register_op(OpDef("ones_like", simple_compute(lambda attrs, x: jnp.ones_like(x)),
+                      num_inputs=1))
+
+    # ---------------- ordering ----------------
+    def _topk(attrs, x):
+        import jax
+
+        axis = attrs.get("axis", -1)
+        k = attrs.get("k", 1)
+        is_ascend = attrs.get("is_ascend", False)
+        ret = attrs.get("ret_typ", "indices")
+        axis = x.ndim - 1 if axis is None else axis % x.ndim
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idxs = jax.lax.top_k(jnp.negative(xm) if is_ascend else xm, k)
+        if is_ascend:
+            vals = jnp.negative(vals)
+        vals = jnp.moveaxis(vals, -1, axis)
+        idxs = jnp.moveaxis(idxs, -1, axis).astype(x.dtype)
+        if ret == "value":
+            return vals
+        if ret == "both":
+            return vals, idxs
+        if ret == "mask":
+            oh = jnp.sum(jax.nn.one_hot(jnp.moveaxis(idxs, axis, -1).astype(jnp.int32),
+                                        x.shape[axis], dtype=x.dtype), axis=-2)
+            return jnp.moveaxis(oh, -1, axis)
+        return idxs
+
+    topk_schema = ParamSchema(Param("axis", lambda s: None if str(s) == "None" else int(float(s)), default=-1),
+                              Param("k", int, default=1),
+                              Param("ret_typ", str, default="indices"),
+                              Param("is_ascend", bool, default=False))
+    register_op(OpDef("topk", simple_compute(_topk), schema=topk_schema, num_inputs=1,
+                      num_outputs=lambda a: 2 if a.get("ret_typ") == "both" else 1))
+
+    def _sort(attrs, x):
+        axis = attrs.get("axis", -1)
+        out = jnp.sort(x, axis=axis)
+        if not attrs.get("is_ascend", True):
+            out = jnp.flip(out, axis=axis if axis is not None else 0)
+        return out
+
+    sort_schema = ParamSchema(Param("axis", lambda s: None if str(s) == "None" else int(float(s)), default=-1),
+                              Param("is_ascend", bool, default=True))
+    register_op(OpDef("sort", simple_compute(_sort), schema=sort_schema, num_inputs=1))
+
+    def _argsort(attrs, x):
+        axis = attrs.get("axis", -1)
+        out = jnp.argsort(x, axis=axis)
+        if not attrs.get("is_ascend", True):
+            out = jnp.flip(out, axis=axis if axis is not None else 0)
+        return out.astype(x.dtype)
+
+    register_op(OpDef("argsort", simple_compute(_argsort), schema=sort_schema,
+                      num_inputs=1))
+
+    # ---------------- control flow ----------------
+    def _where(attrs, cond, x, y):
+        if cond.ndim == 1 and x.ndim > 1:
+            cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.where(cond != 0, x, y)
+
+    register_op(OpDef("where", simple_compute(_where), num_inputs=3,
+                      arguments=["condition", "x", "y"]))
+
+    # ---------------- softmax family (stateless) ----------------
+    def _softmax(attrs, x):
+        import jax
+
+        return jax.nn.softmax(x, axis=attrs.get("axis", -1))
+
+    sm_schema = ParamSchema(Param("axis", int, default=-1),
+                            Param("temperature", lambda s: None if str(s) == "None" else float(s), default=None))
+    register_op(OpDef("softmax", simple_compute(_softmax), schema=sm_schema,
+                      num_inputs=1))
+
+    def _log_softmax(attrs, x):
+        import jax
+
+        return jax.nn.log_softmax(x, axis=attrs.get("axis", -1))
+
+    register_op(OpDef("log_softmax", simple_compute(_log_softmax), schema=sm_schema,
+                      num_inputs=1))
+
+    def _softmax_cross_entropy(attrs, data, label):
+        import jax
+
+        logp = jax.nn.log_softmax(data, axis=-1)
+        lbl = label.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+        return -jnp.sum(picked).reshape((1,))
+
+    register_op(OpDef("softmax_cross_entropy", simple_compute(_softmax_cross_entropy),
+                      num_inputs=2, arguments=["data", "label"]))
+
+
+def _infer_reshape(target, in_shape, reverse=False):
+    """MXNet Reshape semantics: 0 copy-dim, -1 infer, -2 copy-rest, -3 merge,
+    -4 split (src/operator/tensor/matrix_op.cc Reshape docs)."""
+    if not target:
+        return in_shape
+    src = list(in_shape[::-1]) if reverse else list(in_shape)
+    tgt = list(target[::-1]) if reverse else list(target)
+    out = []
+    src_i = 0
+    i = 0
+    while i < len(tgt):
+        s = tgt[i]
+        if s == 0:
+            out.append(src[src_i]); src_i += 1
+        elif s == -1:
+            out.append(-1); src_i += 1
+        elif s == -2:
+            out.extend(src[src_i:]); src_i = len(src)
+        elif s == -3:
+            out.append(src[src_i] * src[src_i + 1]); src_i += 2
+        elif s == -4:
+            a, b = tgt[i + 1], tgt[i + 2]
+            if a == -1:
+                a = src[src_i] // b
+            if b == -1:
+                b = src[src_i] // a
+            out.extend([a, b]); src_i += 1; i += 2
+        else:
+            out.append(s); src_i += 1
+        i += 1
+    if -1 in out:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in in_shape:
+            total *= v
+        out[out.index(-1)] = total // known
+    return tuple(out[::-1]) if reverse else tuple(out)

@@ -1,0 +1,494 @@
+"""Optimizers (reference: python/mxnet/optimizer.py, 755 LoC).
+
+Each ``update(index, weight, grad, state)`` dispatches to the fused update
+ops (`mxnet_tpu/ops/optimizer_ops.py` ↔ reference `src/operator/
+optimizer_op.cc`) — one jitted XLA fusion per update, with state tensors
+written back in place of the reference's engine-mutated NDArrays.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray, zeros
+from .base import MXNetError
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "DCASGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Test", "create",
+           "get_updater", "register"]
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:10-135)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        Optimizer.opt_registry[klass.__name__.lower()] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self._set_lr_wd_mult_from_sym(sym)
+
+    def _set_lr_wd_mult_from_sym(self, sym):
+        self.sym_lr_mult = {}
+        self.sym_wd_mult = {}
+        if sym is not None:
+            attr = sym.attr_dict()
+            for name in sym.list_arguments():
+                if name in attr:
+                    if "__lr_mult__" in attr[name]:
+                        self.sym_lr_mult[name] = float(attr[name]["__lr_mult__"])
+                    if "__wd_mult__" in attr[name]:
+                        self.sym_wd_mult[name] = float(attr[name]["__wd_mult__"])
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi(self, indices, weights, grads, states):
+        """Update many parameters in one step.  Subclasses with a fused
+        whole-model kernel (SGD, Adam) override this: ONE jitted XLA call
+        replaces the reference's per-parameter engine pushes — essential on
+        TPU where per-op dispatch latency would dominate the step."""
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update(i, w, g, s)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # reference convention: no weight decay on bias/gamma/beta
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name is not None and name in self.sym_lr_mult:
+            lr *= self.sym_lr_mult[name]
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif name is not None and name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name is not None and name in self.sym_wd_mult:
+            wd *= self.sym_wd_mult[name]
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif name is not None and name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def _common_kwargs(self):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, via fused sgd(_mom)_update (reference: :279)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self._fused_fn = None
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = self._common_kwargs()
+        if state is not None:
+            new_w, new_m = nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
+                                             momentum=self.momentum, **kw)
+            state._set_data(new_m.data)
+        else:
+            new_w = nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw)
+        weight._set_data(new_w.data)
+
+    def _fused(self):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fused_fn is not None:
+            return self._fused_fn
+        momentum = self.momentum
+        rescale = self.rescale_grad
+        clip = self.clip_gradient
+
+        def fused(ws, gs, ms, lrwd):
+            new_ws, new_ms = [], []
+            for i, (w, g, m) in enumerate(zip(ws, gs, ms)):
+                lr = lrwd[0, i]
+                wd = lrwd[1, i]
+                g = g * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                if momentum != 0.0:
+                    m = momentum * m - lr * (g + wd * w)
+                    w = w + m
+                    new_ms.append(m)
+                else:
+                    w = w - lr * (g + wd * w)
+                    new_ms.append(m)
+                new_ws.append(w)
+            return new_ws, new_ms
+
+        # no donation: NDArray facade may hold other refs to the old buffers
+        self._fused_fn = jax.jit(fused)
+        return self._fused_fn
+
+    def update_multi(self, indices, weights, grads, states):
+        for i in indices:
+            self._update_count(i)
+        # one (2, n) host array for all lr/wd scalars: a single transfer
+        # instead of 2n tiny ones
+        lrwd = np.stack([
+            np.array([self._get_lr(i) for i in indices], np.float32),
+            np.array([self._get_wd(i) for i in indices], np.float32)])
+        ms = [s.data if s is not None else w.data
+              for s, w in zip(states, weights)]
+        new_ws, new_ms = self._fused()([w.data for w in weights],
+                                       [g.data for g in grads], ms, lrwd)
+        for w, nw in zip(weights, new_ws):
+            w._set_data(nw)
+        if self.momentum != 0.0:
+            for s, nm in zip(states, new_ms):
+                s._set_data(nm)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: :330)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: :365)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.normal(loc=0.0, scale=math.sqrt(lr), shape=weight.shape,
+                          ctx=weight.context)
+        weight += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class ccSGD(SGD):
+    """Alias of SGD in this framework (reference ccSGD was a C++ fast path)."""
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: :398)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (grad + wd * weight +
+                       self.lamda * grad * grad * (weight - previous_weight))
+        if mom is not None:
+            mom *= self.momentum
+            mom += delta
+            delta = mom
+        previous_weight._set_data(weight.data)
+        weight += delta
+
+
+@register
+class Adam(Optimizer):
+    """Adam, via fused adam_update (reference: :451)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        mean, var = state
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        new_w, new_mean, new_var = nd.adam_update(
+            weight, grad, mean, var, lr=lr, wd=wd, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, **self._common_kwargs())
+        weight._set_data(new_w.data)
+        mean._set_data(new_mean.data)
+        var._set_data(new_var.data)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: :513)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        history = state
+        history += grad * grad
+        weight += -lr * (grad / nd.sqrt(history + self.float_stable_eps) + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp; centered=True uses Alex Graves' variant (reference: :553)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context))
+        return (zeros(weight.shape, weight.context),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = self._common_kwargs()
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            new_w, new_n = nd.rmsprop_update(
+                weight, grad, n, lr=lr, wd=wd, gamma1=self.gamma1,
+                epsilon=self.epsilon, **kw)
+            n._set_data(new_n.data)
+        else:
+            n, g, delta = state
+            new_w, new_n, new_g, new_delta = nd.rmspropalex_update(
+                weight, grad, n, g, delta, lr=lr, wd=wd, gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon, **kw)
+            n._set_data(new_n.data)
+            g._set_data(new_g.data)
+            delta._set_data(new_delta.data)
+        weight._set_data(new_w.data)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: :608)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set_data((self.rho * acc_g + (1 - self.rho) * grad * grad).data)
+        current_delta = (nd.sqrt(acc_delta + self.epsilon) /
+                         nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta._set_data(
+            (self.rho * acc_delta + (1 - self.rho) * current_delta * current_delta).data)
+        weight += -current_delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference: :652)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        lr = self._get_lr(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        dn, n = state
+        dn += grad - (nd.sqrt(n + grad * grad) - nd.sqrt(n)) * weight / lr
+        n += grad * grad
+        w_np = (nd.sign(dn) * self.lamda1 - dn) / \
+            ((self.beta + nd.sqrt(n)) / lr + wd) * (nd.abs(dn) > self.lamda1)
+        weight._set_data(w_np.data)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w += rescale_grad * grad (reference: :700)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._set_data(weight.data)
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater:
+    """Closure applying an optimizer to (index, grad, weight) pairs —
+    worker-side update (reference: optimizer.py:720 get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def update_multi(self, indices, grads, weights):
+        for index, weight in zip(indices, weights):
+            if index not in self.states:
+                self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update_multi(indices, weights, grads,
+                                    [self.states[i] for i in indices])
+
+    def set_states(self, states):
+        import pickle
+
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        import pickle
+
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

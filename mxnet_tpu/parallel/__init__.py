@@ -1,0 +1,13 @@
+"""Parallelism utilities: device meshes, collectives, multi-host launch.
+
+TPU-native replacement for the reference's distributed stack (SURVEY §2.4/
+§2.5): ps-lite/ZMQ + Comm reduce become XLA collectives over an ICI/DCN
+mesh.  `tools/launch.py` (dmlc-tracker ssh/mpi) becomes
+`mxnet_tpu.parallel.launch.init()` → jax.distributed.
+"""
+from . import collectives
+from .mesh import build_mesh, data_parallel_mesh, MeshConfig
+from . import launch
+
+__all__ = ["collectives", "build_mesh", "data_parallel_mesh", "MeshConfig",
+           "launch"]

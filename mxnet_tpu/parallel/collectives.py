@@ -1,0 +1,44 @@
+"""Cross-process collectives.
+
+Replaces the reference's ps-lite push/pull network path
+(`src/kvstore/kvstore_dist.h`) with XLA collectives spanning all processes'
+devices.  Used by the dist KVStore facade; inside jitted training steps the
+collectives are instead inserted by the SPMD partitioner from sharding
+annotations (no explicit calls needed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["global_sum", "barrier"]
+
+
+def _global_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), ("all",))
+
+
+def global_sum(value):
+    """Sum a (process-local) array across all processes; returns the global
+    sum replicated locally.  The KVStoreDist Push/Pull analog."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(value)
+    return jnp.sum(jnp.asarray(stacked), axis=0)
+
+
+def barrier():
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")

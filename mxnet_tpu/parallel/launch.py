@@ -1,0 +1,53 @@
+"""Multi-host launcher.
+
+Reference: `tools/launch.py` spawns scheduler/server/worker processes with
+DMLC_* env vars through dmlc-tracker (ssh/mpi/yarn/sge).  TPU-native: every
+host is a worker; process group formation is jax.distributed (GRPC), driven
+either by TPU metadata (on Cloud TPU pods, automatic) or by the same
+environment-variable contract (DMLC_PS_ROOT_URI/PORT reused as the
+coordinator address so reference launch tooling keeps working).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["init", "shutdown"]
+
+_initialized = False
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialize the distributed runtime (idempotent)."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return
+    if coordinator_address is None and "DMLC_PS_ROOT_URI" in os.environ:
+        coordinator_address = "%s:%s" % (os.environ["DMLC_PS_ROOT_URI"],
+                                         os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        num_processes = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        process_id = int(os.environ.get("DMLC_WORKER_ID",
+                                        os.environ.get("DMLC_RANK", "0")))
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    else:
+        try:
+            jax.distributed.initialize()  # TPU pod metadata path
+        except Exception:
+            pass  # single-process
+    _initialized = True
+
+
+def shutdown():
+    global _initialized
+    import jax
+
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _initialized = False

@@ -1,0 +1,62 @@
+"""Device-mesh construction.
+
+The scaling-book recipe: pick a mesh (axes: data / model-tensor / pipeline /
+sequence / expert), annotate shardings, let XLA insert the collectives so
+they ride ICI.  This module owns mesh construction for both the Module data
+path (executor_group) and the standalone training-step API (models/).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["MeshConfig", "build_mesh", "data_parallel_mesh"]
+
+
+@dataclass
+class MeshConfig:
+    """Logical mesh shape.  Axis size -1 means 'absorb remaining devices'."""
+
+    data: int = -1     # data parallel (gradient psum)
+    model: int = 1     # tensor parallel (matmul sharding)
+    pipe: int = 1      # pipeline stages
+    seq: int = 1       # sequence/context parallel (ring attention axis)
+    expert: int = 1    # expert parallel (MoE all-to-all)
+    names: tuple = ("data", "model", "pipe", "seq", "expert")
+
+    def resolve(self, n_devices):
+        sizes = [self.data, self.model, self.pipe, self.seq, self.expert]
+        fixed = 1
+        for s in sizes:
+            if s != -1:
+                fixed *= s
+        free = [i for i, s in enumerate(sizes) if s == -1]
+        if free:
+            assert n_devices % fixed == 0, \
+                "devices %d not divisible by fixed axes %d" % (n_devices, fixed)
+            rem = n_devices // fixed
+            sizes[free[0]] = rem
+            for i in free[1:]:
+                sizes[i] = 1
+        total = int(np.prod(sizes))
+        assert total == n_devices, \
+            "mesh %s does not cover %d devices" % (sizes, n_devices)
+        return sizes
+
+
+def build_mesh(config=None, devices=None):
+    """Build a jax Mesh from a MeshConfig over the given (default: all) devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, config.names)
+
+
+def data_parallel_mesh(devices=None):
+    return build_mesh(MeshConfig(data=-1), devices)

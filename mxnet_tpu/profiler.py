@@ -1,0 +1,89 @@
+"""Profiler facade.
+
+Reference: `src/engine/profiler.{h,cc}` + `python/mxnet/profiler.py` — per-op
+engine timestamps dumped as Chrome trace-event JSON.  TPU-native: wraps the
+JAX/XLA profiler (`jax.profiler`), whose traces open in TensorBoard/XProf
+(strictly more detail than the reference's op spans: XLA HLO cost, TPU step
+time, HBM usage).  The reference's chrome-trace file contract is kept:
+``dump_profile()`` writes a chrome-trace JSON with whatever op spans were
+recorded through the python-side span API.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "Scope", "start", "stop"]
+
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False,
+          "events": [], "jax_trace_dir": None}
+_lock = threading.Lock()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Set up the profiler (reference: python/mxnet/profiler.py:10)."""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' or 'stop' (reference: profiler.py:30)."""
+    import jax
+
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["t0"] = time.time()
+        trace_dir = os.path.splitext(_state["filename"])[0] + "_xla"
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _state["jax_trace_dir"] = trace_dir
+        except Exception:  # profiling backend may be unavailable (CPU tests)
+            _state["jax_trace_dir"] = None
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["jax_trace_dir"]:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def start():
+    profiler_set_state("run")
+
+
+def stop():
+    profiler_set_state("stop")
+
+
+class Scope:
+    """Record one named span into the chrome trace (engine OprExecStat analog)."""
+
+    def __init__(self, name, category="operator"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        if _state["running"]:
+            with _lock:
+                _state["events"].append({
+                    "name": self.name, "cat": self.category, "ph": "X",
+                    "ts": int(self._t0 * 1e6),
+                    "dur": int((time.time() - self._t0) * 1e6),
+                    "pid": os.getpid(), "tid": threading.get_ident(),
+                })
+
+
+def dump_profile():
+    """Write chrome-trace JSON (reference: profiler.py:46 dump_profile)."""
+    with _lock:
+        payload = {"traceEvents": list(_state["events"]), "displayTimeUnit": "ms"}
+        with open(_state["filename"], "w") as f:
+            json.dump(payload, f)

@@ -1,0 +1,38 @@
+"""Global PRNG state.
+
+Reference seeds RNG resources through the engine (`src/resource.cc:144-178`,
+`MXRandomSeed`).  TPU-native: one functional ``jax.random`` key chain; each
+random op splits a fresh subkey *outside* jit and passes it in as a traced
+argument, so compiled computations stay pure and reproducible.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "split_key"]
+
+_state = threading.local()
+
+
+def _key():
+    import jax
+
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed all random sources (reference: python/mxnet/random.py:34)."""
+    import jax
+
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def split_key():
+    """Return a fresh subkey, advancing the global chain."""
+    import jax
+
+    k, sub = jax.random.split(_key())
+    _state.key = k
+    return sub

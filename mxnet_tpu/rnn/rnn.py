@@ -1,0 +1,38 @@
+"""RNN checkpoint helpers (reference: python/mxnet/rnn/rnn.py)."""
+from __future__ import annotations
+
+from .. import model as model_mod
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]
+
+
+def _as_list(cells):
+    return cells if isinstance(cells, (list, tuple)) else [cells]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """Save checkpoint with fused weights packed (reference: rnn.py:10)."""
+    cells = _as_list(cells)
+    for cell in cells:
+        arg_params = cell.pack_weights(arg_params)
+    model_mod.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load checkpoint, unpacking fused weights (reference: rnn.py:35)."""
+    sym, arg, aux = model_mod.load_checkpoint(prefix, epoch)
+    cells = _as_list(cells)
+    for cell in cells:
+        arg = cell.unpack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback (reference: rnn.py:61)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

@@ -1,0 +1,662 @@
+"""RNN cell symbol library (reference: python/mxnet/rnn/rnn_cell.py, 962 LoC).
+
+Cells compose Symbols step-by-step (`unroll`), or map onto the fused `RNN`
+op (`FusedRNNCell`) which lowers to lax.scan — the reference's cuDNN path.
+`unfuse()`/pack/unpack_weights convert between the fused flat parameter
+vector (layout documented in ops/rnn_op.py) and per-cell FC weights, so
+unrolled and fused nets interconvert exactly as in the reference
+(tests/python/unittest/test_rnn.py consistency tests).
+"""
+from __future__ import annotations
+
+from .. import symbol
+from ..base import MXNetError
+from ..ops.rnn_op import rnn_param_size, _layout, _gates
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams:
+    """Container for cell parameters (reference: rnn_cell.py:21)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract cell (reference: rnn_cell.py:42)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError()
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=None, _batch_ref=None, _ref_axis=0, **kwargs):
+        """Initial states as symbols (reference: rnn_cell.py:129).
+
+        With ``_batch_ref`` (set by unroll), states are zero tensors whose
+        batch dimension follows the data symbol at bind time (the reference's
+        ``func=sym.zeros``); otherwise they are plain Variables the caller
+        must feed."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called directly."
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
+            if func is not None:
+                state = func(name=name, **kwargs)
+            elif _batch_ref is not None:
+                state = symbol._create(
+                    "_rnn_begin_state", [_batch_ref],
+                    {"shape": str(tuple(info["shape"])),
+                     "batch_axis": str(_ref_axis)}, name=name)
+            else:
+                state = symbol.Variable(name)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Unpack fused weights (identity for unfused cells)."""
+        args = dict(args)
+        return args
+
+    def pack_weights(self, args):
+        args = dict(args)
+        return args
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        """Unroll the cell `length` steps (reference: rnn_cell.py:254)."""
+        self.reset()
+        if inputs is None:
+            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, symbol.Symbol):
+            assert len(inputs) == 1
+            axis = layout.find("T")
+            inputs = getattr(symbol, "SliceChannel")(
+                inputs, axis=axis, num_outputs=length, squeeze_axis=1)
+            inputs = [inputs[i] for i in range(length)]
+        if begin_state is None:
+            begin_state = self.begin_state(_batch_ref=inputs[0], _ref_axis=0)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if merge_outputs:
+            outputs = [symbol.expand_dims(o, axis=1) for o in outputs]
+            outputs = symbol.Concat(*outputs, dim=1)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell: h' = act(W x + R h + b) (reference: rnn_cell.py:325)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                    num_hidden=self._num_hidden,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
+                                    num_hidden=self._num_hidden,
+                                    name="%sh2h" % name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (reference: rnn_cell.py:365). Gate order i,f,g,o."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None, forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        from ..initializer import LSTMBias
+
+        self._iB = self.params.get("i2h_bias",
+                                   init=LSTMBias(forget_bias=forget_bias))
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%sh2h" % name)
+        gates = i2h + h2h
+        slice_gates = symbol.SliceChannel(gates, num_outputs=4, axis=1,
+                                          name="%sslice" % name)
+        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid")
+        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid")
+        in_transform = symbol.Activation(slice_gates[2], act_type="tanh")
+        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (reference: rnn_cell.py:428). Gate order r,z,n."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev_state_h = states[0]
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
+                                    bias=self._hB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%sh2h" % name)
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(i2h, num_outputs=3,
+                                                name="%si2h_slice" % name)
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(h2h, num_outputs=3,
+                                                name="%sh2h_slice" % name)
+        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
+                                       name="%sr_act" % name)
+        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
+                                        name="%sz_act" % name)
+        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h, act_type="tanh",
+                                       name="%sh_act" % name)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN mapping onto the `RNN` op (reference: :497)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm", bidirectional=False,
+                 dropout=0.0, get_next_state=False, forget_bias=1.0,
+                 prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._forget_bias = forget_bias
+        self._parameter = self.params.get("parameters")
+        self._directions = 2 if bidirectional else 1
+
+    @property
+    def state_info(self):
+        b = self._directions
+        n = (self._mode == "lstm") + 1
+        return [{"shape": (b * self._num_layers, 0, self._num_hidden),
+                 "__layout__": "LNC"} for _ in range(n)]
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": [""], "rnn_tanh": [""],
+                "lstm": ["_i", "_f", "_c", "_o"],
+                "gru": ["_r", "_z", "_o"]}[self._mode]
+
+    def _param_layout(self, input_size):
+        return _layout(self._num_layers, self._num_hidden, self._mode,
+                       self._bidirectional, input_size)
+
+    def unpack_weights(self, args, input_size=None):
+        """Split the flat `parameters` array into per-matrix numpy views."""
+        import numpy as np
+
+        args = dict(args)
+        arr = args.pop(self._prefix + "parameters")
+        if hasattr(arr, "asnumpy"):
+            arr = arr.asnumpy()
+        arr = np.asarray(arr)
+        if input_size is None:
+            input_size = self._infer_input_size(arr)
+        for name, off, shape in self._param_layout(input_size):
+            n = int(np.prod(shape))
+            args[self._prefix + name] = arr[off:off + n].reshape(shape).copy()
+        return args
+
+    def pack_weights(self, args, input_size=None):
+        import numpy as np
+
+        args = dict(args)
+        pieces = {}
+        for key in list(args.keys()):
+            if key.startswith(self._prefix) and ("_i2h_" in key or "_h2h_" in key):
+                pieces[key[len(self._prefix):]] = args.pop(key)
+        any_piece = next(iter(pieces.values()))
+        first_w = pieces.get("l0_d0_i2h_weight")
+        if input_size is None:
+            input_size = np.asarray(first_w).shape[-1]
+        total = rnn_param_size(self._num_layers, self._num_hidden, self._mode,
+                               self._bidirectional, input_size)
+        flat = np.zeros((total,), dtype=np.asarray(any_piece).dtype)
+        for name, off, shape in self._param_layout(input_size):
+            v = pieces[name]
+            if hasattr(v, "asnumpy"):
+                v = v.asnumpy()
+            flat[off:off + int(np.prod(shape))] = np.asarray(v).reshape(-1)
+        args[self._prefix + "parameters"] = flat
+        return args
+
+    def _infer_input_size(self, flat):
+        """Solve for input_size from the flat parameter count."""
+        g = _gates(self._mode)
+        d = self._directions
+        H = self._num_hidden
+        L = self._num_layers
+        total = flat.size
+        # total = d*g*H*I + d*g*H*H + (L-1)*d*g*H*(H*d + H) + L*d*2*g*H
+        rest = d * g * H * H + (L - 1) * d * g * H * (H * d + H) + L * d * 2 * g * H
+        return (total - rest) // (d * g * H)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("FusedRNNCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        if isinstance(inputs, list):
+            inputs = [symbol.expand_dims(i, axis=0) for i in inputs]
+            inputs = symbol.Concat(*inputs, dim=0)  # TNC
+        else:
+            if axis == 1:  # NTC -> TNC
+                inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            begin_state = self.begin_state(_batch_ref=inputs, _ref_axis=1)
+        states = list(begin_state)
+
+        rnn_args = dict(state_size=self._num_hidden, num_layers=self._num_layers,
+                        bidirectional=self._bidirectional, mode=self._mode,
+                        p=self._dropout, state_outputs=self._get_next_state,
+                        name="%srnn" % self._prefix)
+        if self._mode == "lstm":
+            rnn = symbol.RNN(inputs, self._parameter, states[0], states[1],
+                             **rnn_args)
+        else:
+            rnn = symbol.RNN(inputs, self._parameter, states[0], **rnn_args)
+
+        if self._get_next_state:
+            outputs = rnn[0]
+            next_states = [rnn[i] for i in range(1, len(self.state_info) + 1)]
+        else:
+            outputs = rnn if len(rnn) == 1 else rnn[0]
+            next_states = []
+
+        if axis == 1:
+            outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
+        if not merge_outputs:
+            outputs = symbol.SliceChannel(outputs, axis=axis, num_outputs=length,
+                                          squeeze_axis=1)
+            outputs = [outputs[i] for i in range(length)]
+        return outputs, next_states
+
+    def unfuse(self):
+        """Equivalent unfused SequentialRNNCell (reference: rnn_cell.py:604)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden, activation="relu",
+                                          prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden, activation="tanh",
+                                          prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell("%sl%d_d0_" % (self._prefix, i)),
+                    get_cell("%sl%d_d1_" % (self._prefix, i)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+            else:
+                stack.add(get_cell("%sl%d_d0_" % (self._prefix, i)))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix="%s_dropout%d_" % (self._prefix, i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells (reference: rnn_cell.py:685)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, \
+                "Either specify params for SequentialRNNCell or child cells, not both."
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        # unroll layer by layer so Bidirectional/Fused children work
+        self.reset()
+        num_cells = len(self._cells)
+        p = 0
+        next_states = []
+        outputs = inputs
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = begin_state[p:p + n] if begin_state is not None else None
+            p += n
+            outputs, states = cell.unroll(
+                length, inputs=outputs, begin_state=states,
+                input_prefix=input_prefix, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(states)
+        return outputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout between layers (reference: rnn_cell.py:763)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        if isinstance(inputs, symbol.Symbol) and merge_outputs is not False:
+            output, _ = self(inputs, [])
+            return output, []
+        return super().unroll(length, inputs, begin_state, input_prefix, layout,
+                              merge_outputs)
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells wrapping another cell (reference: rnn_cell.py:797)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, init_sym=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(**kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference: rnn_cell.py:839)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell does not support zoneout; unfuse() first."
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
+                                     self.zoneout_states)
+        next_output, next_states = cell(inputs, states)
+        mask = lambda p, like: symbol.Dropout(
+            symbol.ones_like(like), p=p)
+
+        prev_output = self.prev_output if self.prev_output is not None \
+            else symbol.zeros_like(next_output)
+        output = (symbol.where(mask(p_outputs, next_output), next_output,
+                               prev_output)
+                  if p_outputs != 0.0 else next_output)
+        states = ([symbol.where(mask(p_states, new_s), new_s, old_s)
+                   for new_s, old_s in zip(next_states, states)]
+                  if p_states != 0.0 else next_states)
+        self.prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """Residual connection around a cell."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Bidirectional wrapper (reference: rnn_cell.py:881)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            assert l_cell._own_params and r_cell._own_params
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        if inputs is None:
+            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, symbol.Symbol):
+            axis = layout.find("T")
+            inputs = symbol.SliceChannel(inputs, axis=axis, num_outputs=length,
+                                         squeeze_axis=1)
+            inputs = [inputs[i] for i in range(length)]
+        l_cell, r_cell = self._cells
+        if begin_state is None:
+            l_begin = r_begin = None
+        else:
+            l_begin = begin_state[:len(l_cell.state_info)]
+            r_begin = begin_state[len(l_cell.state_info):]
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=l_begin,
+            layout=layout, merge_outputs=False)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=r_begin,
+            layout=layout, merge_outputs=False)
+        outputs = [symbol.Concat(l_o, r_o, dim=1,
+                                 name="%st%d" % (self._output_prefix, i))
+                   for i, (l_o, r_o) in enumerate(zip(l_outputs,
+                                                      reversed(r_outputs)))]
+        if merge_outputs:
+            outputs = [symbol.expand_dims(o, axis=1) for o in outputs]
+            outputs = symbol.Concat(*outputs, dim=1)
+        states = l_states + r_states
+        return outputs, states
+
+
+def _cells_unpack_weights(cells, args):
+    for cell in cells:
+        args = cell.unpack_weights(args)
+    return args
+
+
+def _cells_pack_weights(cells, args):
+    for cell in cells:
+        args = cell.pack_weights(args)
+    return args

@@ -1,0 +1,119 @@
+"""Network visualization (reference: python/mxnet/visualization.py)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from . import symbol as sym_mod
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Tabular summary with param counts (reference: visualization.py:20)."""
+    if not isinstance(symbol, sym_mod.Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        arg_names = symbol.list_arguments()
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+        shape_dict = dict(zip(arg_names, arg_shapes))
+        shape_dict.update(dict(zip(symbol.list_auxiliary_states(), aux_shapes)))
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        shape_dict.update(dict(zip(internals.list_outputs(), out_shapes)))
+
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+        cur_param = 0
+        for inp in node.get("inputs", []):
+            input_node = nodes[inp[0]]
+            if input_node["op"] == "null" and not input_node.get("is_aux"):
+                pshape = shape_dict.get(input_node["name"])
+                if pshape and not input_node["name"].endswith(("data", "label")):
+                    n = 1
+                    for s in pshape:
+                        n *= s
+                    cur_param += n
+        first_connection = pre_node[0] if pre_node else ""
+        fields = ["%s(%s)" % (node["name"], op), out_shape, cur_param,
+                  first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        total_params[0] += cur_param
+
+    heads = set(h[0] for h in conf["heads"])
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        out_shape = shape_dict.get(node["name"] + "_output", "") if show_shape else ""
+        print_layer_summary(node, out_shape)
+        print("_" * line_length)
+    print("Total params: %s" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs={}, hide_weights=True):
+    """Graphviz plot (reference: visualization.py:145); requires graphviz."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires graphviz (not installed in "
+                         "this environment)")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    hidden = set()
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and (name.endswith("weight") or name.endswith("bias")
+                                 or name.endswith("gamma") or name.endswith("beta")
+                                 or "moving" in name):
+                hidden.add(i)
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op), shape="box")
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for item in node["inputs"]:
+            if item[0] in hidden:
+                continue
+            dot.edge(tail_name=nodes[item[0]]["name"], head_name=node["name"])
+    return dot

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE any backend
+initialization.
+
+This is the TPU analog of the reference's fake-device trick
+(tests/python/unittest/test_multi_device_exec.py uses mx.cpu(N) contexts):
+multi-chip sharding paths are exercised on one box.  Note: this environment
+pre-imports jax at interpreter startup (TPU platform hook), so env vars are
+too late — jax.config.update is the reliable path.  XLA_FLAGS still works
+because no backend is initialized until the first device query.
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)

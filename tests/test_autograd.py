@@ -1,0 +1,96 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import ndarray as nd
+
+
+def grad_and_loss_check(func, x_np, expected_grad):
+    x = nd.array(x_np)
+    grad_func = autograd.grad_and_loss(func)
+    grads, loss = grad_func(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), expected_grad, rtol=1e-4)
+
+
+def test_unary_func():
+    x_np = np.random.RandomState(0).uniform(0.5, 1.0, (4, 5)).astype(np.float32)
+
+    grad_and_loss_check(lambda x: nd.sum(nd.exp(x)), x_np, np.exp(x_np))
+    grad_and_loss_check(lambda x: nd.sum(x * x), x_np, 2 * x_np)
+
+
+def test_mark_variables_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    g = nd.zeros((3,))
+    autograd.mark_variables([x], [g])
+    with autograd.train_section():
+        y = x * 2 + nd.square(x)
+        autograd.backward([y])
+    np.testing.assert_allclose(g.asnumpy(), 2 + 2 * np.array([1, 2, 3]),
+                               rtol=1e-5)
+
+
+def test_training_flag_dropout():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).any()
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert not (y.asnumpy() == 0).any()
+
+
+def test_out_grads():
+    x = nd.array([1.0, 2.0, 3.0])
+    g = nd.zeros((3,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 1.0
+        autograd.backward([y], out_grads=[nd.array([10.0, 20.0, 30.0])])
+    np.testing.assert_allclose(g.asnumpy(), [10, 20, 30], rtol=1e-6)
+
+
+def test_grad_req_add_accumulates():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g], grad_reqs="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+            autograd.backward([y])
+    np.testing.assert_allclose(g.asnumpy(), [6, 6], rtol=1e-6)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+        autograd.backward([y], retain_graph=True)
+        first = g.asnumpy().copy()
+        autograd.backward([y])
+    np.testing.assert_allclose(first, [4.0], rtol=1e-6)
+
+
+def test_out_param_recording():
+    x = nd.array([1.0, -2.0, 3.0])
+    g = nd.zeros((3,))
+    y = nd.zeros((3,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        nd.relu(x, out=y)
+        z = y * 3
+        autograd.backward([z])
+    np.testing.assert_allclose(g.asnumpy(), [3, 0, 3], rtol=1e-6)
+
+
+def test_argnum():
+    def f_with_mode(a, b):
+        return nd.sum(a * b)
+
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    grads, loss = autograd.grad_and_loss(f_with_mode, argnum=0)(a, b)
+    np.testing.assert_allclose(grads[0].asnumpy(), [3, 4], rtol=1e-6)

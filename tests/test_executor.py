@@ -1,0 +1,95 @@
+"""Executor tests (reference: tests/python/unittest/test_executor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+rng = np.random.RandomState(7)
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    ga = nd.zeros((3, 4))
+    gb = nd.zeros((3, 4))
+    ex = c.bind(mx.cpu(), args={"a": nd.array(x), "b": nd.array(y)},
+                args_grad={"a": ga, "b": gb})
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), x * y, rtol=1e-5)
+    ex.backward(out_grads=nd.ones((3, 4)))
+    np.testing.assert_allclose(ga.asnumpy(), y, rtol=1e-5)
+    np.testing.assert_allclose(gb.asnumpy(), x, rtol=1e-5)
+
+
+def test_forward_kwargs_update():
+    a = sym.Variable("a")
+    s = sym.exp(a)
+    ex = s.bind(mx.cpu(), {"a": nd.zeros((2,))})
+    out1 = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out1, [1, 1], rtol=1e-6)
+    out2 = ex.forward(a=nd.ones((2,)))[0].asnumpy()
+    np.testing.assert_allclose(out2, [np.e, np.e], rtol=1e-5)
+
+
+def test_simple_bind_shares_shapes():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(5, 3))
+    assert ex.arg_dict["fc_weight"].shape == (4, 3)
+    assert ex.grad_dict["fc_weight"].shape == (4, 3)
+    # shared executor reuses buffers of matching shapes
+    ex2 = net.simple_bind(mx.cpu(), data=(5, 3), shared_exec=ex)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+
+
+def test_reshape_executor():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(5, 3))
+    ex2 = ex.reshape(data=(10, 3))
+    assert ex2.arg_dict["data"].shape == (10, 3)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+
+
+def test_multi_output_executor():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1)
+    g = sym.Group([parts[0], parts[1]])
+    x = rng.randn(2, 4).astype(np.float32)
+    ex = g.bind(mx.cpu(), {"data": nd.array(x)})
+    outs = ex.forward()
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0].asnumpy(), x[:, :2], rtol=1e-6)
+
+
+def test_copy_params_from():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3))
+    w = nd.array(rng.randn(4, 3).astype(np.float32))
+    ex.copy_params_from({"fc_weight": w}, allow_extra_params=True)
+    np.testing.assert_array_equal(ex.arg_dict["fc_weight"].asnumpy(),
+                                  w.asnumpy())
+
+
+def test_monitor_callback():
+    seen = []
+    net = sym.exp(sym.Variable("a"))
+    ex = net.bind(mx.cpu(), {"a": nd.ones((2,))})
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward()
+    assert seen  # output observed
+
+
+def test_grad_req_null_skips():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    ex = c.bind(mx.cpu(), args={"a": nd.ones((2,)), "b": nd.ones((2,))},
+                args_grad={"a": nd.zeros((2,))},
+                grad_req={"a": "write", "b": "null"})
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((2,)))
+    np.testing.assert_array_equal(ex.grad_dict["a"].asnumpy(), [1, 1])
+    assert "b" not in ex.grad_dict

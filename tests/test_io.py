@@ -1,0 +1,153 @@
+"""IO tests (reference: test_io.py, test_recordio.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.io import (NDArrayIter, MNISTIter, CSVIter, ResizeIter,
+                          PrefetchingIter, DataBatch)
+
+
+def test_ndarray_iter_basic():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(X, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard_shuffle():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    it = NDArrayIter(X, None, batch_size=3, last_batch_handle="discard",
+                     shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert all(b.pad == 0 for b in batches)
+
+
+def test_ndarray_iter_dict_input():
+    X = {"a": np.zeros((6, 2), dtype=np.float32),
+         "b": np.ones((6, 3), dtype=np.float32)}
+    it = NDArrayIter(X, np.arange(6, dtype=np.float32), batch_size=2)
+    assert {d.name for d in it.provide_data} == {"a", "b"}
+    batch = next(iter(it))
+    assert len(batch.data) == 2
+
+
+def test_mnist_iter_synthetic():
+    it = MNISTIter(batch_size=50, seed=0)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (50, 1, 28, 28)
+    assert batch.label[0].shape == (50,)
+    it_flat = MNISTIter(batch_size=50, flat=True, seed=0)
+    assert next(iter(it_flat)).data[0].shape == (50, 784)
+
+
+def test_csv_iter():
+    with tempfile.TemporaryDirectory() as tmp:
+        data_path = os.path.join(tmp, "data.csv")
+        label_path = os.path.join(tmp, "label.csv")
+        X = np.random.randn(10, 3).astype(np.float32)
+        y = np.arange(10, dtype=np.float32)
+        np.savetxt(data_path, X, delimiter=",")
+        np.savetxt(label_path, y, delimiter=",")
+        it = CSVIter(data_csv=data_path, data_shape=(3,),
+                     label_csv=label_path, batch_size=5)
+        batch = next(iter(it))
+        assert batch.data[0].shape == (5, 3)
+        np.testing.assert_allclose(batch.data[0].asnumpy(), X[:5], rtol=1e-5)
+
+
+def test_resize_iter():
+    X = np.zeros((10, 2), dtype=np.float32)
+    base = NDArrayIter(X, np.zeros(10, dtype=np.float32), batch_size=5)
+    resized = ResizeIter(base, size=5)
+    assert len(list(resized)) == 5
+
+
+def test_prefetching_iter():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    base = NDArrayIter(X, np.zeros(10, dtype=np.float32), batch_size=2)
+    pf = PrefetchingIter(base)
+    batches = list(pf)
+    assert len(batches) == 5
+    pf.reset()
+    assert len(list(pf)) == 5
+
+
+def test_recordio_roundtrip():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "test.rec")
+        writer = recordio.MXRecordIO(path, "w")
+        for i in range(5):
+            writer.write(b"record%d" % i)
+        writer.close()
+        reader = recordio.MXRecordIO(path, "r")
+        for i in range(5):
+            assert reader.read() == b"record%d" % i
+        assert reader.read() is None
+        reader.close()
+
+
+def test_indexed_recordio():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "test.rec")
+        idx_path = os.path.join(tmp, "test.idx")
+        writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+        for i in range(5):
+            writer.write_idx(i, b"rec%d" % i)
+        writer.close()
+        reader = recordio.MXIndexedRecordIO(idx_path, path, "r")
+        assert reader.read_idx(3) == b"rec3"
+        assert reader.read_idx(0) == b"rec0"
+        reader.close()
+
+
+def test_irheader_pack_unpack():
+    hdr = recordio.IRHeader(0, 5.0, 7, 0)
+    packed = recordio.pack(hdr, b"payload")
+    hdr2, data = recordio.unpack(packed)
+    assert hdr2.label == 5.0
+    assert hdr2.id == 7
+    assert data == b"payload"
+    # array label
+    hdr3 = recordio.IRHeader(0, np.array([1.0, 2.0], dtype=np.float32), 1, 0)
+    packed3 = recordio.pack(hdr3, b"x")
+    hdr4, data4 = recordio.unpack(packed3)
+    np.testing.assert_array_equal(hdr4.label, [1.0, 2.0])
+    assert data4 == b"x"
+
+
+def test_pack_img_roundtrip():
+    img = np.random.randint(0, 255, (8, 9, 3)).astype(np.uint8)
+    # png is lossless under both the cv2 and raw-array codecs
+    packed = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                               quality=3, img_fmt=".png")
+    hdr, img2 = recordio.unpack_img(packed)
+    np.testing.assert_array_equal(img, img2)
+
+
+def test_image_iter_from_rec():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "img.rec")
+        idx_path = os.path.join(tmp, "img.idx")
+        writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+        rng = np.random.RandomState(0)
+        for i in range(20):
+            img = rng.randint(0, 255, (12, 12, 3)).astype(np.uint8)
+            writer.write_idx(i, recordio.pack_img(
+                recordio.IRHeader(0, float(i % 4), i, 0), img))
+        writer.close()
+        it = mx.image.ImageIter(batch_size=4, data_shape=(3, 8, 8),
+                                path_imgrec=path, path_imgidx=idx_path,
+                                rand_crop=True, rand_mirror=True)
+        batch = next(iter(it))
+        assert batch.data[0].shape == (4, 3, 8, 8)
+        assert batch.label[0].shape == (4,)

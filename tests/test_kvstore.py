@@ -1,0 +1,77 @@
+"""KVStore tests (reference: tests/python/unittest/test_kvstore.py:1-125)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kv_type="local"):
+    kv = mx.kvstore.create(kv_type)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, nd.ones(SHAPE) * 4)
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert np.all(out.asnumpy() == 4)
+
+
+def test_list_kv_pair():
+    kv = _init_kv()
+    kv.push(KEYS, [nd.ones(SHAPE) * 4] * len(KEYS))
+    outs = [nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert np.all(o.asnumpy() == 4)
+
+
+def test_aggregator():
+    kv = _init_kv()
+    num_devs = 4
+    devs = [mx.cpu(i) for i in range(num_devs)]
+    vals = [nd.ones(SHAPE, d) for d in devs]
+    kv.push(3, vals)
+    outs = [nd.zeros(SHAPE, d) for d in devs]
+    kv.pull(3, out=outs)
+    for o in outs:
+        assert np.all(o.asnumpy() == num_devs)
+
+
+def test_updater():
+    kv = _init_kv()
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv.set_updater(updater)
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert np.all(out.asnumpy() == 2)
+    kv.push(3, [nd.ones(SHAPE) for _ in range(4)])
+    kv.pull(3, out=out)
+    assert np.all(out.asnumpy() == 2 + 8)
+
+
+def test_get_type_rank():
+    kv = mx.kvstore.create("local")
+    assert kv.type == "local"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_set_optimizer():
+    kv = _init_kv("device")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    # sgd: w -= lr * grad => -1
+    assert np.all(out.asnumpy() == -1)

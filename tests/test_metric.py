@@ -1,0 +1,96 @@
+"""Metric tests (reference metric semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1.0, 0.0, 0.0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = nd.array([2.0, 1.0])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert acc == 1.0
+
+
+def test_mse_mae_rmse():
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([0.0, 4.0])
+    mse = mx.metric.MSE()
+    mse.update([label], [pred])
+    assert abs(mse.get()[1] - (1 + 4) / 2) < 1e-6
+    mae = mx.metric.MAE()
+    mae.update([label], [pred])
+    assert abs(mae.get()[1] - 1.5) < 1e-6
+    rmse = mx.metric.RMSE()
+    rmse.update([label], [pred])
+    assert abs(rmse.get()[1] - np.sqrt(2.5)) < 1e-6
+
+
+def test_cross_entropy_perplexity():
+    ce = mx.metric.CrossEntropy()
+    pred = nd.array([[0.9, 0.1], [0.2, 0.8]])
+    label = nd.array([0.0, 1.0])
+    ce.update([label], [pred])
+    expected = -(np.log(0.9) + np.log(0.8)) / 2
+    assert abs(ce.get()[1] - expected) < 1e-5
+    ppl = mx.metric.Perplexity(ignore_label=None)
+    ppl.update([label], [pred])
+    assert abs(ppl.get()[1] - np.exp(expected)) < 1e-4
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1.0, 0.0, 1.0])
+    m.update([label], [pred])
+    assert m.get()[1] > 0
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    m2 = mx.metric.create("acc")
+    assert isinstance(m2, mx.metric.Accuracy)
+    with pytest.raises(ValueError):
+        mx.metric.create("doesnotexist")
+    with pytest.raises(ValueError):
+        m.get_metric(99)
+
+
+def test_custom_metric():
+    @mx.metric.np_metric(name="double")
+    def double(label, pred):
+        return 2.0
+
+    double.update([nd.array([0.0])], [nd.array([[1.0]])])
+    assert double.get()[1] == 2.0
+
+
+def test_initializers_smoke():
+    for init in [mx.initializer.Uniform(), mx.initializer.Normal(),
+                 mx.initializer.Xavier(), mx.initializer.Orthogonal(),
+                 mx.initializer.MSRAPrelu(), mx.initializer.One(),
+                 mx.initializer.Zero(), mx.initializer.Constant(3.0)]:
+        arr = nd.zeros((8, 4))
+        init("test_weight", arr)
+        assert np.all(np.isfinite(arr.asnumpy()))
+    arr = nd.zeros((12,))
+    mx.initializer.LSTMBias(forget_bias=1.0)("lstm_i2h_bias", arr)
+    v = arr.asnumpy()
+    assert np.all(v[3:6] == 1.0) and v.sum() == 3.0
+    b = nd.zeros((5,))
+    mx.initializer.Uniform()("fc_bias", b)
+    assert np.all(b.asnumpy() == 0)

@@ -1,0 +1,159 @@
+"""Module tests (reference: tests/python/unittest/test_module.py + train/)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import DataBatch, NDArrayIter
+
+
+def _mlp(num_hidden=16, num_classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=400, dim=10, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    W = np.random.RandomState(99).randn(dim, classes).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    return X, y
+
+
+def test_module_fit_learns():
+    X, y = _toy_data()
+    it = NDArrayIter(X, y, batch_size=40, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, initializer=mx.initializer.Xavier(), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=6)
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_module_forward_shapes():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = DataBatch([nd.ones((8, 10))], [nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (8, 4)
+
+
+def test_module_checkpoint_roundtrip():
+    X, y = _toy_data()
+    it = NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, initializer=mx.initializer.Xavier(), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=2)
+    ref = dict(mod.score(it, "acc"))["accuracy"]
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "m")
+        mod.save_checkpoint(prefix, 2)
+        mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+        mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+                  for_training=False)
+        acc = dict(mod2.score(it, "acc"))["accuracy"]
+        assert acc == ref
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))])
+    mod.init_params(mx.initializer.One())
+    args, auxs = mod.get_params()
+    assert np.all(args["fc1_weight"].asnumpy() == 1)
+    args["fc1_weight"][:] = 2.0
+    mod.set_params(args, auxs)
+    args2, _ = mod.get_params()
+    assert np.all(args2["fc1_weight"].asnumpy() == 2)
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier())
+    batch = DataBatch([nd.ones((8, 10))], [nd.zeros((8,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()
+    assert ig[0].shape == (8, 10)
+    assert float(np.abs(ig[0].asnumpy()).sum()) > 0
+
+
+def test_module_update_on_kvstore_device():
+    X, y = _toy_data()
+    it = NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, initializer=mx.initializer.Xavier(), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=4,
+            kvstore="device")
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.85, acc
+
+
+def test_module_fixed_params():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu(), fixed_param_names=["fc1_weight"])
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    before = mod._exec_group.exec_.arg_dict["fc1_weight"].asnumpy().copy()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 1.0})
+    batch = DataBatch([nd.array(np.random.randn(8, 10).astype(np.float32))],
+                      [nd.zeros((8,))])
+    mod.forward_backward(batch)
+    mod.update()
+    after = mod._exec_group.exec_.arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(before, after)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=4, name="fc")
+        return sym.SoftmaxOutput(net, name="softmax"), ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataDesc
+
+    mod.bind(data_shapes=[DataDesc("data", (4, 10))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    for key in (10, 6, 10):
+        batch = DataBatch([nd.ones((4, key))], [nd.zeros((4,))],
+                          bucket_key=key,
+                          provide_data=[DataDesc("data", (4, key))],
+                          provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward_backward(batch)
+        mod.update()
+    assert set(mod._buckets.keys()) == {10, 6}
+    # weight of input-dependent fc differs per bucket but biases are shared
+    b10 = mod._buckets[10]._exec_group.exec_.arg_dict["fc_bias"]
+    b6 = mod._buckets[6]._exec_group.exec_.arg_dict["fc_bias"]
+    assert b10 is b6
+
+
+def test_feedforward_api():
+    X, y = _toy_data()
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=4,
+                                 optimizer="sgd", learning_rate=0.5,
+                                 initializer=mx.initializer.Xavier(),
+                                 numpy_batch_size=40)
+    model.fit(X, y)
+    preds = model.predict(X)
+    assert preds.shape == (400, 4)
+    acc = (np.argmax(preds, 1) == y).mean()
+    assert acc > 0.85, acc

@@ -1,0 +1,184 @@
+"""NDArray tests (reference: tests/python/unittest/test_ndarray.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_ndarray_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.all(a.asnumpy() == 0)
+    b = nd.ones((2, 2), dtype="float64")
+    assert b.asnumpy().dtype == np.float64
+    c = nd.full((2,), 7.5)
+    assert np.all(c.asnumpy() == 7.5)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert np.array_equal(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_ndarray_elementwise():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    a, b = nd.array(x), nd.array(y)
+    np.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), x - y, rtol=1e-6)
+    np.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-6)
+    np.testing.assert_allclose((a / b).asnumpy(), x / y, rtol=1e-5)
+    np.testing.assert_allclose((a + 1).asnumpy(), x + 1, rtol=1e-6)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - x, rtol=1e-6)
+    np.testing.assert_allclose((a * 3).asnumpy(), x * 3, rtol=1e-6)
+    np.testing.assert_allclose((1 / (a + 10)).asnumpy(), 1 / (x + 10), rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), -x, rtol=1e-6)
+
+
+def test_ndarray_inplace():
+    x = nd.ones((2, 3))
+    x += 2
+    assert np.all(x.asnumpy() == 3)
+    x *= 2
+    assert np.all(x.asnumpy() == 6)
+    x -= 1
+    assert np.all(x.asnumpy() == 5)
+    x /= 5
+    assert np.all(x.asnumpy() == 1)
+
+
+def test_ndarray_indexing():
+    x = nd.array(np.arange(20, dtype=np.float32).reshape(4, 5))
+    assert np.array_equal(x[1].asnumpy(), np.arange(5, 10))
+    assert np.array_equal(x[1:3].asnumpy(),
+                          np.arange(20).reshape(4, 5)[1:3])
+    x[0] = 42
+    assert np.all(x.asnumpy()[0] == 42)
+    x[1:3] = 7
+    assert np.all(x.asnumpy()[1:3] == 7)
+    # write-through views
+    v = x[2:4]
+    v[0] = 11
+    assert np.all(x.asnumpy()[2] == 11)
+
+
+def test_ndarray_reshape_transpose():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert x.reshape((6, 4)).shape == (6, 4)
+    assert x.reshape((-1, 4)).shape == (6, 4)
+    assert x.T.shape == (4, 3, 2)
+    assert nd.transpose(x, axes=(1, 0, 2)).shape == (3, 2, 4)
+    assert x.reshape((0, -1)).shape == (2, 12)
+
+
+def test_ndarray_dot():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(5, 3).astype(np.float32)
+    out = nd.dot(nd.array(x), nd.array(y))
+    np.testing.assert_allclose(out.asnumpy(), x @ y, rtol=1e-5)
+    out_t = nd.dot(nd.array(x.T), nd.array(y), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), x @ y, rtol=1e-5)
+
+
+def test_ndarray_reduce():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sum(a).asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), x.sum(axis=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a, axis=(0, 2)).asnumpy(),
+                               x.max(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.sum(a, axis=1, exclude=True).asnumpy(), x.sum(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(nd.mean(a, axis=0, keepdims=True).asnumpy(),
+                               x.mean(axis=0, keepdims=True), rtol=1e-5)
+
+
+def test_ndarray_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert np.array_equal((a > b).asnumpy(), [0, 0, 1])
+    assert np.array_equal((a == b).asnumpy(), [0, 1, 0])
+    assert np.array_equal((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_ndarray_save_load():
+    with tempfile.TemporaryDirectory() as tmp:
+        fname = os.path.join(tmp, "nds")
+        x = nd.array(np.random.randn(3, 4).astype(np.float32))
+        y = nd.arange(0, 5)
+        nd.save(fname, [x, y])
+        back = nd.load(fname)
+        assert len(back) == 2
+        np.testing.assert_array_equal(back[0].asnumpy(), x.asnumpy())
+        nd.save(fname, {"x": x, "y": y})
+        back = nd.load(fname)
+        assert set(back.keys()) == {"x", "y"}
+        np.testing.assert_array_equal(back["y"].asnumpy(), y.asnumpy())
+
+
+def test_ndarray_copy_context():
+    x = nd.ones((2, 2))
+    y = x.copy()
+    x += 1
+    assert np.all(y.asnumpy() == 1)
+    z = x.as_in_context(mx.cpu(1))
+    assert z.context == mx.cpu(1)
+    np.testing.assert_array_equal(z.asnumpy(), x.asnumpy())
+    w = nd.zeros((2, 2))
+    x.copyto(w)
+    np.testing.assert_array_equal(w.asnumpy(), x.asnumpy())
+
+
+def test_ndarray_broadcast():
+    x = nd.array(np.ones((2, 1, 3), dtype=np.float32))
+    assert x.broadcast_to((2, 4, 3)).shape == (2, 4, 3)
+    a = nd.array(np.ones((2, 3)))
+    b = nd.array(np.ones((1, 3)))
+    assert nd.broadcast_add(a, b).shape == (2, 3)
+
+
+def test_ndarray_concat_split():
+    x = nd.ones((2, 3))
+    y = nd.zeros((2, 3))
+    c = nd.concatenate([x, y], axis=0)
+    assert c.shape == (4, 3)
+    c2 = nd.Concat(x, y, dim=1)
+    assert c2.shape == (2, 6)
+    parts = nd.SliceChannel(c2, num_outputs=2, axis=1)
+    assert parts[0].shape == (2, 3)
+    np.testing.assert_array_equal(parts[0].asnumpy(), x.asnumpy())
+
+
+def test_ndarray_scalar_ops():
+    x = nd.array([4.0])
+    assert x.asscalar() == 4.0
+    assert float(nd.sqrt(x).asnumpy()[0]) == 2.0
+    assert bool(x > 3)
+
+
+def test_ndarray_astype():
+    x = nd.ones((2,), dtype="float32")
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+
+
+def test_onehot_encode():
+    idx = nd.array([0.0, 2.0])
+    out = nd.zeros((2, 3))
+    nd.onehot_encode(idx, out)
+    np.testing.assert_array_equal(out.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_waitall():
+    x = nd.ones((100, 100))
+    for _ in range(5):
+        x = x * 1.00001
+    nd.waitall()

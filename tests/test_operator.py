@@ -1,0 +1,396 @@
+"""Operator tests (reference: tests/python/unittest/test_operator.py, 3159 LoC).
+
+Uses the reference's numerics trio: numpy-reference forward checks,
+finite-difference gradient checks, symbolic backward checks.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward, check_symbolic_backward)
+
+rng = np.random.RandomState(12345)
+
+
+def test_unary_ops_forward():
+    x = rng.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "abs": np.abs, "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+        "sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+        "relu": lambda v: np.maximum(v, 0),
+        "reciprocal": lambda v: 1.0 / v,
+        "rsqrt": lambda v: 1.0 / np.sqrt(v),
+        "log1p": np.log1p, "expm1": np.expm1,
+    }
+    for name, ref in cases.items():
+        out = getattr(nd, name)(nd.array(x))
+        assert_almost_equal(out.asnumpy(), ref(x), rtol=1e-5, atol=1e-6,
+                            names=(name, "np_" + name))
+
+
+def test_binary_broadcast_forward():
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(1, 3, 1).astype(np.float32) + 2.0
+    for name, ref in [("broadcast_add", np.add), ("broadcast_sub", np.subtract),
+                      ("broadcast_mul", np.multiply),
+                      ("broadcast_div", np.divide),
+                      ("broadcast_maximum", np.maximum),
+                      ("broadcast_minimum", np.minimum)]:
+        out = getattr(nd, name)(nd.array(a), nd.array(b))
+        assert_almost_equal(out.asnumpy(), ref(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_elemwise_grad():
+    data = sym.Variable("data")
+    for s in [sym.exp(data), sym.tanh(data), sym.sigmoid(data),
+              sym.square(data)]:
+        check_numeric_gradient(s, [rng.randn(3, 4) * 0.5], rtol=0.05)
+
+
+def test_fc_forward_backward():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    x = rng.randn(5, 3).astype(np.float32)
+    w = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    check_symbolic_forward(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           [x @ w.T + b], rtol=1e-4)
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           rtol=0.05, numeric_eps=1e-2)
+
+
+def test_fc_no_bias():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+    assert fc.list_arguments() == ["data", "fc_weight"]
+    x = rng.randn(5, 3).astype(np.float32)
+    w = rng.randn(4, 3).astype(np.float32)
+    check_symbolic_forward(fc, {"data": x, "fc_weight": w}, [x @ w.T])
+
+
+def _np_conv(x, w, b, stride, pad):
+    from jax import lax
+    import jax.numpy as jnp
+
+    out = lax.conv_general_dilated(jnp.asarray(x), jnp.asarray(w),
+                                   window_strides=stride,
+                                   padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                                   dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return np.asarray(out) + b.reshape(1, -1, 1, 1)
+
+
+def test_convolution():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=4, stride=(2, 2),
+                           pad=(1, 1), name="conv")
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=x.shape)
+    assert arg_shapes[1] == (4, 3, 3, 3)
+    assert out_shapes[0] == (2, 4, 4, 4)
+    w = (rng.randn(4, 3, 3, 3) * 0.1).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    # XLA-CPU f32 convs carry ~3e-3 absolute error vs f64 ground truth
+    check_symbolic_forward(conv, {"data": x, "conv_weight": w, "conv_bias": b},
+                           [_np_conv(x, w, b, (2, 2), (1, 1))], rtol=2e-2,
+                           atol=1e-2)
+    check_numeric_gradient(conv, {"data": x, "conv_weight": w, "conv_bias": b},
+                           rtol=0.1, numeric_eps=1e-2)
+
+
+def test_pooling():
+    data = sym.Variable("data")
+    x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    pool = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expected = np.array([[[[5, 7], [13, 15]], [[21, 23], [29, 31]]]],
+                        dtype=np.float32)
+    check_symbolic_forward(pool, [x], [expected])
+    pool_avg = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expected_avg = np.array([[[[2.5, 4.5], [10.5, 12.5]],
+                              [[18.5, 20.5], [26.5, 28.5]]]], dtype=np.float32)
+    check_symbolic_forward(pool_avg, [x], [expected_avg])
+    gp = sym.Pooling(data, kernel=(1, 1), global_pool=True, pool_type="max")
+    check_symbolic_forward(gp, [x], [x.max(axis=(2, 3), keepdims=True)])
+
+
+def test_activation_grad():
+    data = sym.Variable("data")
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        s = sym.Activation(data, act_type=act)
+        check_numeric_gradient(s, [rng.randn(3, 4)], rtol=0.05, numeric_eps=1e-2)
+
+
+def test_leaky_relu():
+    data = sym.Variable("data")
+    x = np.array([[-2.0, 2.0]], dtype=np.float32)
+    out = sym.LeakyReLU(data, act_type="leaky", slope=0.1)
+    check_symbolic_forward(out, [x], [np.array([[-0.2, 2.0]], dtype=np.float32)])
+    elu = sym.LeakyReLU(data, act_type="elu", slope=0.5)
+    check_symbolic_forward(elu, [x],
+                           [np.array([[0.5 * (np.exp(-2.0) - 1), 2.0]],
+                                     dtype=np.float32)])
+
+
+def test_softmax_output_backward():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    s = sym.SoftmaxOutput(data, label, name="sm")
+    x = rng.randn(4, 5).astype(np.float32)
+    lbl = np.array([0, 1, 2, 3], dtype=np.float32)
+
+    def softmax(v):
+        e = np.exp(v - v.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    p = softmax(x)
+    onehot = np.eye(5, dtype=np.float32)[lbl.astype(int)]
+    check_symbolic_forward(s, {"data": x, "label": lbl}, [p], rtol=1e-4)
+    check_symbolic_backward(s, {"data": x, "label": lbl},
+                            [np.ones_like(p)], {"data": p - onehot},
+                            grad_req={"data": "write", "label": "null"},
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_training():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, fix_gamma=False, name="bn")
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    x = rng.randn(8, 3, 4, 4).astype(np.float32)
+    ex = bn.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.arg_dict["bn_beta"][:] = 0.0
+    out = ex.forward(is_train=True)[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-3)
+    assert_almost_equal(out, expected, rtol=1e-3, atol=1e-4)
+    # moving stats updated
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert_almost_equal(mm, 0.1 * mean.ravel(), rtol=1e-3, atol=1e-5)
+
+
+def test_dropout():
+    data = sym.Variable("data")
+    d = sym.Dropout(data, p=0.5)
+    x = np.ones((200, 200), dtype=np.float32)
+    ex = d.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    out_test = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(out_test, x)  # identity at inference
+    out_train = ex.forward(is_train=True)[0].asnumpy()
+    frac_zero = (out_train == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    kept = out_train[out_train != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+
+
+def test_reshape_flatten_transpose():
+    data = sym.Variable("data")
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(sym.Reshape(data, shape=(6, 4)), [x],
+                           [x.reshape(6, 4)])
+    check_symbolic_forward(sym.Reshape(data, shape=(0, -1)), [x],
+                           [x.reshape(2, 12)])
+    check_symbolic_forward(sym.Flatten(data), [x], [x.reshape(2, 12)])
+    check_symbolic_forward(sym.transpose(data), [x], [x.T])
+    check_symbolic_forward(sym.expand_dims(data, axis=1), [x],
+                           [x[:, None]])
+
+
+def test_concat_slice():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    x = rng.randn(2, 3).astype(np.float32)
+    y = rng.randn(2, 4).astype(np.float32)
+    c = sym.Concat(a, b, dim=1)
+    check_symbolic_forward(c, {"a": x, "b": y}, [np.concatenate([x, y], 1)])
+    data = sym.Variable("data")
+    s = sym.slice_axis(data, axis=1, begin=1, end=3)
+    check_symbolic_forward(s, [x], [x[:, 1:3]])
+    sl = sym.slice(data, begin=(0, 1), end=(2, 3))
+    check_symbolic_forward(sl, [x], [x[0:2, 1:3]])
+
+
+def test_split():
+    data = sym.Variable("data")
+    x = rng.randn(2, 6).astype(np.float32)
+    s = sym.SliceChannel(data, num_outputs=3, axis=1)
+    outs = [x[:, 0:2], x[:, 2:4], x[:, 4:6]]
+    check_symbolic_forward(s, [x], outs)
+
+
+def test_embedding_take():
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=10, output_dim=4, name="emb")
+    idx = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    w = rng.randn(10, 4).astype(np.float32)
+    check_symbolic_forward(emb, {"data": idx, "emb_weight": w},
+                           [w[idx.astype(int)]])
+    # take
+    a = sym.Variable("a")
+    i = sym.Variable("indices")
+    t = sym.take(a, i)
+    check_symbolic_forward(t, {"a": w, "indices": np.array([0.0, 5.0])},
+                           [w[[0, 5]]])
+
+
+def test_one_hot_pick_where():
+    idx = nd.array([0.0, 2.0])
+    out = nd.one_hot(idx, depth=3)
+    np.testing.assert_array_equal(out.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    data = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    picked = nd.pick(data, nd.array([0.0, 1.0]))
+    np.testing.assert_array_equal(picked.asnumpy(), [1.0, 4.0])
+    cond = nd.array([[1.0, 0.0], [0.0, 1.0]])
+    w = nd.where(cond, data, -data)
+    np.testing.assert_array_equal(w.asnumpy(), [[1, -2], [-3, 4]])
+
+
+def test_ordering_ops():
+    x = rng.randn(5, 6).astype(np.float32)
+    a = nd.array(x)
+    s = nd.sort(a, axis=1)
+    np.testing.assert_allclose(s.asnumpy(), np.sort(x, axis=1), rtol=1e-6)
+    ags = nd.argsort(a, axis=1)
+    np.testing.assert_array_equal(ags.asnumpy(), np.argsort(x, axis=1))
+    tk = nd.topk(a, k=2, axis=1, ret_typ="value")
+    np.testing.assert_allclose(tk.asnumpy(), np.sort(x, axis=1)[:, :-3:-1],
+                               rtol=1e-6)
+    am = nd.argmax(a, axis=1)
+    np.testing.assert_array_equal(am.asnumpy(), np.argmax(x, axis=1))
+
+
+def test_elemwise_sum():
+    arrays = [rng.randn(2, 3).astype(np.float32) for _ in range(4)]
+    out = nd.add_n(*[nd.array(a) for a in arrays])
+    np.testing.assert_allclose(out.asnumpy(), sum(arrays), rtol=1e-5)
+
+
+def test_blockgrad_makeloss():
+    data = sym.Variable("data")
+    x = rng.randn(3, 4).astype(np.float32)
+    bg = sym.BlockGrad(data)
+    check_symbolic_backward(bg, [x], [np.ones_like(x)],
+                            [np.zeros_like(x)], rtol=1e-5, atol=1e-6)
+    ml = sym.MakeLoss(data, grad_scale=2.0)
+    check_symbolic_backward(ml, [x], [np.ones_like(x)],
+                            [np.full_like(x, 2.0)], rtol=1e-5, atol=1e-6)
+
+
+def test_regression_outputs():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    x = rng.randn(4, 3).astype(np.float32)
+    l = rng.randn(4, 3).astype(np.float32)
+    lin = sym.LinearRegressionOutput(data, label)
+    check_symbolic_forward(lin, {"data": x, "label": l}, [x])
+    check_symbolic_backward(lin, {"data": x, "label": l}, [np.ones_like(x)],
+                            {"data": (x - l) / 3},
+                            grad_req={"data": "write", "label": "null"},
+                            rtol=1e-4, atol=1e-5)
+    log = sym.LogisticRegressionOutput(data, label)
+    sig = 1 / (1 + np.exp(-x))
+    check_symbolic_forward(log, {"data": x, "label": l}, [sig])
+
+
+def test_upsampling_pad():
+    data = sym.Variable("data")
+    x = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2)
+    up = sym.UpSampling(data, scale=2, sample_type="nearest")
+    out = np.repeat(np.repeat(x, 2, 2), 2, 3)
+    check_symbolic_forward(up, [x], [out])
+    pad = sym.Pad(data, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    check_symbolic_forward(pad, [x],
+                           [np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))])
+
+
+def test_sequence_ops():
+    data = sym.Variable("data")
+    sl = sym.Variable("seqlen")
+    x = rng.randn(4, 3, 2).astype(np.float32)  # TNC
+    lens = np.array([2.0, 3.0, 4.0])
+    last = sym.SequenceLast(data, sl, use_sequence_length=True)
+    expected = np.stack([x[1, 0], x[2, 1], x[3, 2]])
+    check_symbolic_forward(last, {"data": x, "seqlen": lens}, [expected])
+    mask = sym.SequenceMask(data, sl, use_sequence_length=True, value=-1.0)
+    exp_mask = x.copy()
+    exp_mask[2:, 0] = -1.0
+    exp_mask[3:, 1] = -1.0
+    check_symbolic_forward(mask, {"data": x, "seqlen": lens}, [exp_mask])
+    rev = sym.SequenceReverse(data, sl, use_sequence_length=True)
+    exp_rev = x.copy()
+    exp_rev[:2, 0] = x[:2, 0][::-1]
+    exp_rev[:3, 1] = x[:3, 1][::-1]
+    exp_rev[:4, 2] = x[:4, 2][::-1]
+    check_symbolic_forward(rev, {"data": x, "seqlen": lens}, [exp_rev])
+
+
+def test_norm_ops():
+    x = rng.randn(4, 6).astype(np.float32)
+    data = sym.Variable("data")
+    l2 = sym.L2Normalization(data, mode="instance")
+    expected = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    check_symbolic_forward(l2, [x], [expected], rtol=1e-4)
+    inorm = sym.InstanceNorm(sym.Variable("data"), sym.Variable("gamma"),
+                             sym.Variable("beta"))
+    xi = rng.randn(2, 3, 4).astype(np.float32)
+    g = np.ones(3, dtype=np.float32)
+    b = np.zeros(3, dtype=np.float32)
+    m = xi.mean(axis=2, keepdims=True)
+    v = xi.var(axis=2, keepdims=True)
+    check_symbolic_forward(inorm, {"data": xi, "gamma": g, "beta": b},
+                           [(xi - m) / np.sqrt(v + 1e-3)], rtol=1e-4)
+
+
+def test_clip_smooth_l1():
+    x = np.array([-3.0, -0.5, 0.5, 3.0], dtype=np.float32)
+    out = nd.clip(nd.array(x), a_min=-1.0, a_max=1.0)
+    np.testing.assert_array_equal(out.asnumpy(), [-1, -0.5, 0.5, 1])
+    s = nd.smooth_l1(nd.array(x), scalar=1.0)
+    expected = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
+    np.testing.assert_allclose(s.asnumpy(), expected, rtol=1e-5)
+
+
+def test_cast():
+    x = nd.array([1.5, 2.5])
+    y = nd.Cast(x, dtype="int32")
+    assert y.dtype == np.int32
+    z = nd.cast(x, dtype="float64")
+    assert z.dtype == np.float64
+
+
+def test_batch_dot():
+    a = rng.randn(3, 2, 4).astype(np.float32)
+    b = rng.randn(3, 4, 5).astype(np.float32)
+    out = nd.batch_dot(nd.array(a), nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_repeat_tile_reverse():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    np.testing.assert_array_equal(
+        nd.repeat(nd.array(x), repeats=2, axis=1).asnumpy(),
+        np.repeat(x, 2, axis=1))
+    np.testing.assert_array_equal(nd.tile(nd.array(x), reps=(2, 1)).asnumpy(),
+                                  np.tile(x, (2, 1)))
+    np.testing.assert_array_equal(nd.reverse(nd.array(x), axis=(0,)).asnumpy(),
+                                  x[::-1])
+
+
+def test_grad_req_add():
+    data = sym.Variable("data")
+    s = sym.MakeLoss(sym.sum(sym.square(data)))
+    x = rng.randn(3).astype(np.float32)
+    init_grad = np.array([1.0, 1.0, 1.0], dtype=np.float32)
+    grad = nd.array(init_grad.copy())
+    ex = s.bind(mx.cpu(), args={"data": nd.array(x)},
+                args_grad={"data": grad}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(grad.asnumpy(), init_grad + 2 * x, rtol=1e-4)

@@ -1,0 +1,133 @@
+"""Optimizer tests vs numpy references (reference: test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+rng = np.random.RandomState(3)
+
+
+def _run_updates(opt, w0, grads, name=0):
+    w = nd.array(w0.copy())
+    state = opt.create_state(name, w)
+    for g in grads:
+        opt.update(name, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = rng.randn(4, 3).astype(np.float32)
+    grads = [rng.randn(4, 3).astype(np.float32) for _ in range(5)]
+    lr, wd, mom = 0.1, 0.01, 0.9
+    opt = mx.optimizer.SGD(learning_rate=lr, momentum=mom, wd=wd,
+                           rescale_grad=1.0)
+    out = _run_updates(opt, w0, grads)
+    # numpy reference
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = mom * m - lr * (g + wd * w)
+        w = w + m
+    np.testing.assert_allclose(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum_clip():
+    w0 = np.zeros((3,), dtype=np.float32)
+    grads = [np.array([10.0, -10.0, 0.5], dtype=np.float32)]
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=1.0)
+    out = _run_updates(opt, w0, grads)
+    np.testing.assert_allclose(out, [-1.0, 1.0, -0.5], rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = rng.randn(5).astype(np.float32)
+    grads = [rng.randn(5).astype(np.float32) for _ in range(4)]
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    out = _run_updates(opt, w0, grads)
+    w = w0.copy().astype(np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        g = g.astype(np.float64)
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w -= lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(out, w, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop():
+    w0 = rng.randn(5).astype(np.float32)
+    grads = [rng.randn(5).astype(np.float32) for _ in range(3)]
+    lr, rho, eps = 0.01, 0.95, 1e-8
+    opt = mx.optimizer.RMSProp(learning_rate=lr, gamma1=rho, epsilon=eps)
+    out = _run_updates(opt, w0, grads)
+    w = w0.copy().astype(np.float64)
+    n = np.zeros_like(w)
+    for g in grads:
+        g = g.astype(np.float64)
+        n = rho * n + (1 - rho) * g * g
+        w -= lr * g / np.sqrt(n + eps)
+    np.testing.assert_allclose(out, w, rtol=1e-4, atol=1e-6)
+
+
+def test_adagrad_adadelta_ftrl_run():
+    w0 = rng.randn(4).astype(np.float32)
+    grads = [rng.randn(4).astype(np.float32) for _ in range(3)]
+    for opt in [mx.optimizer.AdaGrad(learning_rate=0.1),
+                mx.optimizer.AdaDelta(),
+                mx.optimizer.Ftrl(),
+                mx.optimizer.NAG(learning_rate=0.1, momentum=0.9),
+                mx.optimizer.SGLD(learning_rate=0.1),
+                mx.optimizer.DCASGD(learning_rate=0.1, momentum=0.9)]:
+        out = _run_updates(opt, w0, grads)
+        assert out.shape == w0.shape
+        assert np.all(np.isfinite(out))
+        assert not np.allclose(out, w0)  # something moved
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    multi = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    multi.base_lr = 1.0
+    assert multi(3) == 1.0
+    assert abs(multi(7) - 0.1) < 1e-12
+    assert abs(multi(20) - 0.01) < 1e-12
+
+
+def test_lr_wd_mult_from_symbol():
+    from mxnet_tpu import symbol as sym
+
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight", lr_mult=0.0)
+    net = sym.FullyConnected(data, weight=w, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    opt = mx.optimizer.SGD(learning_rate=1.0, sym=net,
+                           param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    assert opt._get_lr(0) == 0.0
+    assert opt._get_lr(1) == 1.0
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.ones((3,))
+    upd(0, nd.ones((3,)), w)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.get_updater(mx.optimizer.SGD(learning_rate=0.1,
+                                                     momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+
+
+def test_create_by_name():
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    assert isinstance(opt, mx.optimizer.Adam)
+    with pytest.raises(ValueError):
+        mx.optimizer.create("nope")

@@ -1,0 +1,53 @@
+"""Random op tests (reference: tests/python/unittest/test_random.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_uniform_moments():
+    mx.random.seed(7)
+    x = nd.uniform(low=-2.0, high=2.0, shape=(2000,))
+    v = x.asnumpy()
+    assert abs(v.mean()) < 0.15
+    assert abs(v.var() - 16.0 / 12) < 0.2
+    assert v.min() >= -2.0 and v.max() <= 2.0
+
+
+def test_normal_moments():
+    mx.random.seed(8)
+    x = nd.normal(loc=1.0, scale=2.0, shape=(4000,))
+    v = x.asnumpy()
+    assert abs(v.mean() - 1.0) < 0.15
+    assert abs(v.std() - 2.0) < 0.2
+
+
+def test_seed_determinism():
+    mx.random.seed(42)
+    a = nd.uniform(shape=(10,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.uniform(shape=(10,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.uniform(shape=(10,)).asnumpy()
+    assert not np.array_equal(b, c)
+
+
+def test_gamma_exponential_poisson():
+    mx.random.seed(9)
+    g = nd.random_gamma(alpha=9.0, beta=0.5, shape=(3000,)).asnumpy()
+    assert abs(g.mean() - 4.5) < 0.3
+    e = nd.random_exponential(lam=4.0, shape=(3000,)).asnumpy()
+    assert abs(e.mean() - 0.25) < 0.05
+    p = nd.random_poisson(lam=4.0, shape=(3000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.3
+
+
+def test_symbol_random():
+    from mxnet_tpu import symbol as sym
+
+    s = sym.uniform(low=0.0, high=1.0, shape=(3, 3))
+    ex = s.bind(mx.cpu(), {})
+    out = ex.forward()[0]
+    assert out.shape == (3, 3)
+    v = out.asnumpy()
+    assert v.min() >= 0 and v.max() <= 1

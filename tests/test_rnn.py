@@ -1,0 +1,147 @@
+"""RNN tests (reference: tests/python/unittest/test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import rnn
+
+rng = np.random.RandomState(11)
+
+
+def _unroll_and_run(cell, T=3, N=2, C=4, H=None):
+    inputs = sym.Variable("data")
+    outputs, states = cell.unroll(T, inputs=inputs, layout="NTC",
+                                  merge_outputs=True)
+    args = {n: (N, T, C) for n in ["data"]}
+    arg_shapes, out_shapes, _ = outputs.infer_shape(
+        data=(N, T, C), **{n: None for n in [] if n})
+    ex = outputs.simple_bind(mx.cpu(), data=(N, T, C),
+                             **{n: s for n, s in zip([], [])})
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rng.uniform(-0.1, 0.1, arr.shape)
+    out = ex.forward()[0]
+    return out, ex
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = rnn.RNNCell(num_hidden=8, prefix="rnn_")
+    out, ex = _unroll_and_run(cell)
+    assert out.shape == (2, 3, 8)
+    assert set(cell.params._params.keys()) == {
+        "rnn_i2h_weight", "rnn_i2h_bias", "rnn_h2h_weight", "rnn_h2h_bias"}
+
+
+def test_lstm_cell_unroll():
+    cell = rnn.LSTMCell(num_hidden=8, prefix="lstm_")
+    out, ex = _unroll_and_run(cell)
+    assert out.shape == (2, 3, 8)
+
+
+def test_gru_cell_unroll():
+    cell = rnn.GRUCell(num_hidden=8, prefix="gru_")
+    out, ex = _unroll_and_run(cell)
+    assert out.shape == (2, 3, 8)
+
+
+def test_stack_and_bidirectional():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(num_hidden=8, prefix="l0_"))
+    stack.add(rnn.LSTMCell(num_hidden=8, prefix="l1_"))
+    out, ex = _unroll_and_run(stack)
+    assert out.shape == (2, 3, 8)
+
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(num_hidden=4, prefix="fw_"),
+                               rnn.LSTMCell(num_hidden=4, prefix="bw_"))
+    out, ex = _unroll_and_run(bi)
+    assert out.shape == (2, 3, 8)  # concat of both directions
+
+
+def test_fused_rnn_shapes():
+    cell = rnn.FusedRNNCell(num_hidden=8, num_layers=2, mode="lstm",
+                            prefix="lstm_", get_next_state=True)
+    inputs = sym.Variable("data")
+    outputs, states = cell.unroll(3, inputs=inputs, layout="NTC",
+                                  merge_outputs=True)
+    g = sym.Group([outputs] + states)
+    ex = g.simple_bind(mx.cpu(), data=(2, 3, 4))
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rng.uniform(-0.1, 0.1, arr.shape)
+    outs = ex.forward()
+    assert outs[0].shape == (2, 3, 8)
+    assert outs[1].shape == (2, 2, 8)  # state h (L, N, H)
+    assert outs[2].shape == (2, 2, 8)  # state c
+
+
+def test_fused_vs_unfused_consistency():
+    """Fused RNN op vs step-unrolled cells with identical packed weights
+    (the reference's test_rnn.py consistency oracle)."""
+    T, N, C, H = 3, 2, 4, 5
+    fused = rnn.FusedRNNCell(num_hidden=H, num_layers=1, mode="lstm",
+                             prefix="lstm_")
+    outputs, _ = fused.unroll(T, inputs=sym.Variable("data"), layout="NTC",
+                              merge_outputs=True)
+    ex = outputs.simple_bind(mx.cpu(), data=(N, T, C))
+    x = rng.uniform(-1, 1, (N, T, C)).astype(np.float32)
+    flat = rng.uniform(-0.1, 0.1,
+                       ex.arg_dict["lstm_parameters"].shape).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["lstm_parameters"][:] = flat
+    fused_out = ex.forward()[0].asnumpy()
+
+    # unfused: unpack the flat weights into per-cell args
+    unfused = fused.unfuse()
+    outputs_u, _ = unfused.unroll(T, inputs=sym.Variable("data"), layout="NTC",
+                                  merge_outputs=True)
+    ex_u = outputs_u.simple_bind(mx.cpu(), data=(N, T, C))
+    args = fused.unpack_weights({"lstm_parameters": flat}, input_size=C)
+    ex_u.arg_dict["data"][:] = x
+    for name, val in args.items():
+        # unpacked names: lstm_l0_d0_{i2h,h2h}_{weight,bias}; cell prefix matches
+        if name in ex_u.arg_dict:
+            ex_u.arg_dict[name][:] = val
+    unfused_out = ex_u.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    cell = rnn.FusedRNNCell(num_hidden=6, num_layers=2, mode="gru",
+                            bidirectional=True, prefix="gru_")
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+
+    psize = rnn_param_size(2, 6, "gru", True, 4)
+    flat = rng.uniform(-1, 1, (psize,)).astype(np.float32)
+    args = cell.unpack_weights({"gru_parameters": flat}, input_size=4)
+    assert "gru_parameters" not in args
+    packed = cell.pack_weights(args, input_size=4)
+    np.testing.assert_allclose(packed["gru_parameters"], flat, rtol=1e-6)
+
+
+def test_dropout_zoneout_residual_cells():
+    base = rnn.LSTMCell(num_hidden=4, prefix="l_")
+    z = rnn.ZoneoutCell(base, zoneout_outputs=0.2, zoneout_states=0.2)
+    outputs, _ = z.unroll(3, inputs=sym.Variable("data"), layout="NTC",
+                          merge_outputs=True)
+    ex = outputs.simple_bind(mx.cpu(), data=(2, 3, 4))
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rng.uniform(-0.1, 0.1, arr.shape)
+    assert ex.forward()[0].shape == (2, 3, 4)
+
+    res = rnn.ResidualCell(rnn.RNNCell(num_hidden=4, prefix="r_"))
+    outputs, _ = res.unroll(3, inputs=sym.Variable("data"), layout="NTC",
+                            merge_outputs=True)
+    ex = outputs.simple_bind(mx.cpu(), data=(2, 3, 4))
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rng.uniform(-0.1, 0.1, arr.shape)
+    assert ex.forward()[0].shape == (2, 3, 4)
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5], [3, 4], [1, 2]] * 4
+    it = rnn.BucketSentenceIter(sentences, batch_size=2, buckets=[3, 5],
+                                invalid_label=0)
+    batch = next(iter(it))
+    assert batch.bucket_key in (3, 5)
+    assert batch.data[0].shape[0] == 2
+    assert batch.provide_data[0].shape == batch.data[0].shape

@@ -1,0 +1,164 @@
+"""Symbol tests (reference: test_symbol.py, test_attr.py, test_infer_shape.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=5, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_arguments():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+
+
+def test_auto_naming():
+    with mx.NameManager():
+        fc = sym.FullyConnected(sym.Variable("data"), num_hidden=4)
+        assert fc.name == "fullyconnected0"
+        fc2 = sym.FullyConnected(fc, num_hidden=4)
+        assert fc2.name == "fullyconnected1"
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 20))
+    assert arg_shapes == [(8, 20), (10, 20), (10,), (5, 10), (5,), (8,)]
+    assert out_shapes == [(8, 5)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4)
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_variable_shape_attr():
+    v = sym.Variable("x", shape=(3, 4))
+    s = sym.exp(v)
+    _, out_shapes, _ = s.infer_shape()
+    assert out_shapes == [(3, 4)]
+
+
+def test_group_and_getitem():
+    with mx.NameManager():  # fresh auto-name counters
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        g = sym.Group([sym.exp(a), sym.log(b)])
+    assert len(g) == 2
+    assert g.list_outputs() == ["exp0_output", "log0_output"]
+    first = g[0]
+    assert first.list_outputs() == ["exp0_output"]
+    byname = g["log0_output"]
+    assert byname.list_outputs() == ["log0_output"]
+
+
+def test_symbol_arith():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2 - 1
+    ex = c.bind(mx.cpu(), {"a": mx.nd.ones((2,)), "b": mx.nd.ones((2,))})
+    np.testing.assert_array_equal(ex.forward()[0].asnumpy(), [2, 2])
+    d = 2 / (a + 1)
+    ex = d.bind(mx.cpu(), {"a": mx.nd.ones((2,))})
+    np.testing.assert_array_equal(ex.forward()[0].asnumpy(), [1, 1])
+    e = a ** 2
+    ex = e.bind(mx.cpu(), {"a": mx.nd.array([3.0])})
+    np.testing.assert_array_equal(ex.forward()[0].asnumpy(), [9])
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        fc = sym.FullyConnected(a, num_hidden=3, name="fc")
+    assert fc.attr("ctx_group") == "dev1"
+    assert a.attr("ctx_group") == "dev1"
+    # nested scopes merge
+    with mx.AttrScope(x="1"):
+        with mx.AttrScope(y="2"):
+            b = sym.Variable("b")
+    assert b.attr("x") == "1" and b.attr("y") == "2"
+
+
+def test_attr_dict_and_set():
+    v = sym.Variable("v", lr_mult=2.0)
+    assert v.attr("__lr_mult__") == "2.0"
+    d = v.attr_dict()
+    assert d["v"]["__lr_mult__"] == "2.0"
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(4, 6))
+    a2, o2, _ = net2.infer_shape(data=(4, 6))
+    assert o1 == o2 and a1 == a2
+    with tempfile.TemporaryDirectory() as tmp:
+        f = os.path.join(tmp, "sym.json")
+        net.save(f)
+        net3 = sym.load(f)
+        assert net3.list_arguments() == net.list_arguments()
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    feat = internals["fc1_output"]
+    _, out_shapes, _ = feat.infer_shape(data=(2, 20))
+    assert out_shapes == [(2, 10)]
+
+
+def test_bucketing_shared_shapes():
+    # same-named symbols of different shapes share params (bucketing pattern)
+    def make(seq_len):
+        data = sym.Variable("data")
+        return sym.FullyConnected(data, num_hidden=4, name="fc")
+
+    s1, s2 = make(5), make(10)
+    a1, _, _ = s1.infer_shape(data=(2, 8))
+    a2, _, _ = s2.infer_shape(data=(4, 8))
+    assert a1[1] == a2[1]  # fc_weight same shape
+
+
+def test_bn_aux_states():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 4, 4))
+    assert aux_shapes == [(3,), (3,)]
+    assert out_shapes == [(2, 3, 4, 4)]
+
+
+def test_infer_shape_error_names_unknown_inputs():
+    net = sym.FullyConnected(sym.Variable("d"), num_hidden=4)
+    with pytest.raises(MXNetError, match="unknown shapes"):
+        net.infer_shape(data=(2, 3))
+
+
+def test_variadic_concat_symbol():
+    ins = [sym.Variable("x%d" % i) for i in range(3)]
+    c = sym.Concat(*ins, dim=0)
+    _, out_shapes, _ = c.infer_shape(x0=(1, 2), x1=(2, 2), x2=(3, 2))
+    assert out_shapes == [(6, 2)]
