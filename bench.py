@@ -2,12 +2,14 @@
 """Benchmark: ResNet-50 ImageNet-shape training throughput on one TPU chip.
 
 Mirrors the reference's headline benchmark
-(`example/image-classification/train_imagenet.py --benchmark 1`, bs32 —
+(`example/image-classification/train_imagenet.py --benchmark 1` —
 BASELINE.md: 181.53 img/s on P100).  Synthetic data (as --benchmark 1 uses),
-full training step: forward + backward through the jitted executor +
-SGD-momentum update.
+full training step: forward + backward + SGD-momentum update, compiled as
+ONE donated XLA program (bf16 compute, fp32 master weights) — see
+mxnet_tpu/train_step.py.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"} plus
+sustained TFLOP/s and MFU on stderr.
 """
 import json
 import os
@@ -18,6 +20,32 @@ import numpy as np
 
 BASELINE_IMG_S = 181.53  # ResNet-50 train bs32, P100 (docs/how_to/perf.md:188)
 
+# fwd-pass FLOPs for ResNet-50 at 224x224 (2 * MACs); backward ~= 2x forward
+RESNET50_FWD_FLOPS = 4.1e9
+TRAIN_FLOPS_PER_IMG = 3 * RESNET50_FWD_FLOPS
+
+# peak bf16 FLOP/s per chip by TPU generation (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v2": 45e12 / 2,      # per-chip: 2 cores, 22.5T each
+    "TPU v3": 123e12 / 2,
+    "TPU v4": 275e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+    "TPU7x": 2307e12,
+}
+
+
+def _peak_for(device):
+    kind = getattr(device, "device_kind", "")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak, kind
+    return None, kind
+
 
 def main():
     import mxnet_tpu as mx
@@ -25,18 +53,23 @@ def main():
     from mxnet_tpu.io import DataBatch
     from mxnet_tpu import ndarray as nd
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+    batch_size = int(os.environ.get("BENCH_BATCH", "256"))
     n_iters = int(os.environ.get("BENCH_ITERS", "20"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     warmup = 5
 
     import jax
 
     platform = jax.devices()[0].platform
     ctx = mx.tpu() if platform != "cpu" else mx.cpu()
+    if platform == "cpu":
+        batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+        n_iters = 3
+        warmup = 1
 
     net = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224))
-    mod = mx.mod.Module(net, context=ctx)
+    mod = mx.mod.Module(net, context=ctx, compute_dtype=dtype)
     mod.bind(data_shapes=[("data", (batch_size, 3, 224, 224))],
              label_shapes=[("softmax_label", (batch_size,))])
     mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
@@ -44,6 +77,8 @@ def main():
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                                          "wd": 1e-4})
+    if mod._fused_step is None:
+        print("WARNING: fused train step not active", file=sys.stderr)
 
     rng = np.random.RandomState(0)
     x = nd.array(rng.uniform(-1, 1, (batch_size, 3, 224, 224)).astype(np.float32),
@@ -56,7 +91,11 @@ def main():
         # fetching a value derived from the last update is a reliable fence
         import jax.numpy as jnp
 
-        return float(jnp.sum(mod._exec_group.param_arrays[-1].data))
+        if mod._fused_step is not None:
+            src = next(iter(mod._fused_step.params.values()))
+        else:
+            src = mod._exec_group.param_arrays[-1].data
+        return float(jnp.sum(src.astype(jnp.float32)))
 
     for _ in range(warmup):
         mod.forward_backward(batch)
@@ -71,6 +110,14 @@ def main():
     toc = time.time()
 
     img_s = batch_size * n_iters / (toc - tic)
+    tflops = img_s * TRAIN_FLOPS_PER_IMG / 1e12
+    peak, kind = _peak_for(jax.devices()[0])
+    mfu = tflops * 1e12 / peak if peak else None
+    print(json.dumps({
+        "device": kind, "dtype": dtype, "batch": batch_size,
+        "sustained_tflops": round(tflops, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }), file=sys.stderr)
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_bs%d" % batch_size,
         "value": round(img_s, 2),

@@ -1,0 +1,114 @@
+"""Runtime environment-variable configuration registry.
+
+TPU-native analog of the reference's ~25 ``dmlc::GetEnv`` runtime knobs
+catalogued in ``docs/how_to/env_var.md:8-94`` (engine threads, memory-pool
+reserve, bulk-exec caps, ...).  Most of those knobs configure machinery XLA
+subsumes (thread pools, memory planner), so the registry here is smaller but
+the *mechanism* is the same: every runtime flag is declared in one place with
+a type, default and docstring, read once, and discoverable via
+``config.describe()`` instead of scattered ``os.environ`` reads.
+
+Variables keep the ``MXNET_`` prefix for reference compatibility.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["EnvVar", "register", "get", "describe", "refresh"]
+
+_REGISTRY = {}
+
+
+def _parse_bool(s):
+    return str(s).lower() in ("1", "true", "yes", "on")
+
+
+class EnvVar:
+    """One declared runtime flag."""
+
+    __slots__ = ("name", "type", "default", "doc", "_value", "_loaded")
+
+    def __init__(self, name, type, default, doc):
+        self.name = name
+        self.type = type
+        self.default = default
+        self.doc = doc
+        self._value = None
+        self._loaded = False
+
+    def get(self):
+        if not self._loaded:
+            raw = os.environ.get(self.name)
+            if raw is None:
+                self._value = self.default
+            elif self.type is bool:
+                self._value = _parse_bool(raw)
+            else:
+                self._value = self.type(raw)
+            self._loaded = True
+        return self._value
+
+    def reset(self):
+        self._loaded = False
+
+
+def register(name, type, default, doc):
+    """Declare a runtime flag; returns the EnvVar."""
+    var = EnvVar(name, type, default, doc)
+    _REGISTRY[name] = var
+    return var
+
+
+def get(name):
+    """Read a declared flag (cached after first read)."""
+    return _REGISTRY[name].get()
+
+
+def refresh(name=None):
+    """Drop the cached value(s) so the next get() re-reads the environment."""
+    if name is not None:
+        _REGISTRY[name].reset()
+    else:
+        for var in _REGISTRY.values():
+            var.reset()
+
+
+def describe():
+    """Human-readable catalog of every declared flag (env_var.md analog)."""
+    lines = []
+    for name in sorted(_REGISTRY):
+        var = _REGISTRY[name]
+        lines.append("%s (%s, default=%r)\n    %s"
+                     % (name, var.type.__name__, var.default, var.doc))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Declared flags.  Reference counterparts cited where one exists.
+# ---------------------------------------------------------------------------
+register("MXNET_COMPUTE_DTYPE", str, "",
+         "Default compute dtype for compiled train steps ('bfloat16', "
+         "'float32', ...). Empty = float32. Master weights stay float32. "
+         "TPU-era replacement for the reference's fp16 casting idiom.")
+register("MXNET_FUSED_TRAIN_STEP", bool, True,
+         "Fuse forward+backward+optimizer into one donated XLA program in "
+         "Module when the optimizer supports it (analog of the reference's "
+         "bulk-exec segments, graph_executor.cc:678-756).")
+register("MXNET_EXEC_BULK_EXEC_INFERENCE", bool, True,
+         "Jit-compile whole inference graphs (reference env_var.md: bulk "
+         "execution for inference). Off = per-op eager interpretation for "
+         "debugging, the NaiveEngine analog.")
+register("MXNET_BACKWARD_DO_MIRROR", bool, False,
+         "Trade compute for memory by rematerializing activations in the "
+         "backward pass via jax.checkpoint (reference env_var.md mirror).")
+register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+         "Arrays larger than this many elements are treated as 'big' by the "
+         "kvstore sharding heuristics (reference kvstore_dist.h:276).")
+register("MXNET_ENGINE_TYPE", str, "",
+         "Set to 'NaiveEngine' to force eager, per-op execution for "
+         "debugging (reference src/engine/engine.cc:13-39).")
+register("MXNET_PROFILER_AUTOSTART", bool, False,
+         "Start the profiler at import time (reference env_var.md:71-79).")
+register("MXNET_CPU_WORKER_NTHREADS", int, 1,
+         "Worker threads for host-side data-pipeline work (decode, augment); "
+         "device scheduling itself is XLA's (reference: engine CPU pool).")

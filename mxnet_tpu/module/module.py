@@ -22,8 +22,20 @@ from .executor_group import DataParallelExecutorGroup
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None, state_names=None):
+                 fixed_param_names=None, state_names=None, compute_dtype=None):
         super().__init__(logger=logger)
+        if compute_dtype is None:
+            from .. import config as _config
+
+            compute_dtype = _config.get("MXNET_COMPUTE_DTYPE") or None
+        self._compute_dtype = compute_dtype
+        # fused-train-step state (see ..train_step.CompiledTrainStep)
+        self._fused_step = None
+        self._fused_outputs = None
+        self._fused_update_done = False   # update() becomes a no-op for it
+        self._step_stale = False          # executor arrays newer than step
+        self._exec_stale = False          # step newer than executor arrays
+        self._opt_owner = "eager"         # who holds live optimizer slots
         if context is None:
             context = ctx_mod.cpu()
         if isinstance(context, ctx_mod.Context):
@@ -164,6 +176,7 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)
+        self._step_stale = self._fused_step is not None
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
@@ -176,6 +189,7 @@ class Module(BaseModule):
             return
         self._exec_group.set_params(arg_params, aux_params)
         self._params_dirty = True
+        self._step_stale = self._fused_step is not None
         self.params_initialized = True
 
     # ------------------------------------------------------------------
@@ -219,6 +233,15 @@ class Module(BaseModule):
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def _reset_bind(self):
+        # the fused step holds the live master weights; pull them back into
+        # the host param dicts before the executor they came from is dropped
+        if self._fused_step is not None and self.params_initialized:
+            self._sync_params_from_devices()
+        self._fused_step = None
+        self._fused_outputs = None
+        self._fused_update_done = False
+        self._step_stale = False
+        self._exec_stale = False
         self.binded = False
         self._exec_group = None
         self._data_shapes = None
@@ -277,23 +300,99 @@ class Module(BaseModule):
             self._updater = opt_mod.get_updater(optimizer)
 
         self.optimizer_initialized = True
+        self._maybe_build_fused_step()
+        self._opt_owner = "fused" if self._fused_step is not None else "eager"
 
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    def _maybe_build_fused_step(self):
+        """Compile forward+backward+optimizer into one donated XLA program
+        when the configuration allows it (single-process kvstore, optimizer
+        with a fused kernel, grad_req=write)."""
+        from .. import config as _config
+
+        self._flush_fused()  # re-init must not revert trained weights
+        self._fused_step = None
+        if not _config.get("MXNET_FUSED_TRAIN_STEP"):
+            return
+        if not self.for_training:
+            return
+        if self._kvstore is not None and self._kvstore.type.startswith("dist"):
+            return  # cross-process reduction rides the kvstore path
+        if self.inputs_need_grad:
+            return  # caller wants data grads materialized
+        if self._optimizer.fused_kernel() is None:
+            self.logger.info(
+                "optimizer %s has no fused kernel; using eager update path",
+                type(self._optimizer).__name__)
+            return
+        from ..train_step import CompiledTrainStep
+
+        try:
+            self._fused_step = CompiledTrainStep(
+                self._exec_group, self._optimizer,
+                compute_dtype=self._compute_dtype)
+        except MXNetError as exc:
+            self.logger.info("fused train step unavailable (%s); using "
+                             "eager update path", exc)
+
     def borrow_optimizer(self, shared_module):
-        """Share optimizer state with another module (bucketing)."""
+        """Share optimizer state with another module (bucketing).  Bucketed
+        modules share parameter buffers through the executor, so they use the
+        eager update path (one fused step per bucket would fork the master
+        weights)."""
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._fused_step = None
+        self._opt_owner = "eager"
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------------
+    def forward_backward(self, data_batch):
+        """One training forward+backward.  With a fused step compiled, this
+        runs the entire donated program (including the optimizer update —
+        the following ``update()`` call is then a no-op)."""
+        if self._fused_step is not None:
+            self._run_fused(data_batch)
+        else:
+            self.forward(data_batch, is_train=True)
+            self.backward()
+
+    def _run_fused(self, data_batch):
+        from .. import ndarray as _nd
+
+        if self._step_stale:
+            self._fused_step.load_from_executor()
+            self._step_stale = False
+        if self._opt_owner == "eager":
+            # momentum/Adam moments accumulated on the eager path carry over
+            if self._updater is not None and self._updater.states:
+                self._fused_step.import_updater_states(
+                    self._updater.states, self._exec_group.param_names)
+            self._opt_owner = "fused"
+        outs = self._fused_step.run(data_batch)
+        ctx = self._context[0]
+        self._fused_outputs = [_nd.NDArray(o, ctx) for o in outs]
+        self._fused_update_done = True
+        self._exec_stale = True
+        self._params_dirty = True
+
+    def _flush_fused(self):
+        """Bring the executor's NDArray buffers up to date with the fused
+        step's master state (eval / checkpoint / classic-path boundary)."""
+        if self._fused_step is not None and self._exec_stale:
+            self._fused_step.flush_to_executor()
+            self._exec_stale = False
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        self._flush_fused()
+        self._fused_outputs = None
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -301,9 +400,16 @@ class Module(BaseModule):
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
-        """Optimizer step (reference: module.py:553)."""
+        """Optimizer step (reference: module.py:553).  No-op when the
+        preceding forward_backward already ran the fused program."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        if self._fused_update_done:
+            self._fused_update_done = False
+            return
         self._params_dirty = True
+        if self._fused_step is not None:
+            self._handoff_fused_to_eager()
+            self._step_stale = True
         group = self._exec_group
         if self._update_on_kvstore:
             for idx, (name, w, g) in enumerate(zip(group.param_names,
@@ -334,6 +440,8 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_outputs is not None:
+            return list(self._fused_outputs)
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -341,15 +449,34 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        self._exec_group.update_metric(eval_metric, labels)
+        if self._fused_outputs is not None:
+            eval_metric.update(labels, self._fused_outputs)
+        else:
+            self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
+        self._flush_fused()
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
+    def _handoff_fused_to_eager(self):
+        """Move live state (params + optimizer slots) from the fused step to
+        the eager path so momentum/moments survive the switch."""
+        if self._fused_step is None or self._opt_owner != "fused":
+            return
+        self._flush_fused()
+        if self._updater is not None:
+            self._fused_step.export_updater_states(
+                self._updater, self._exec_group.param_names,
+                self._context[0])
+        self._opt_owner = "eager"
+
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused_step is not None and self._opt_owner == "fused":
+            with open(fname, "wb") as fout:
+                fout.write(self._fused_step.get_states())
+        elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
             with open(fname, "wb") as fout:
@@ -357,12 +484,21 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused_step is not None:
+            with open(fname, "rb") as fin:
+                self._fused_step.set_states(fin.read())
+            self._opt_owner = "fused"
+        elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as fin:
                 self._updater.set_states(fin.read())
 
     def install_monitor(self, mon):
+        """Per-op output taps require the interpreted executor path, so a
+        monitored module drops back to eager forward/backward/update."""
         assert self.binded
+        if self._fused_step is not None:
+            self._handoff_fused_to_eager()
+            self._fused_step = None
         self._exec_group.install_monitor(mon)

@@ -331,18 +331,23 @@ def register_all():
         bshape = tuple(data.shape[caxis] if i == caxis else 1 for i in range(data.ndim))
         if attrs.get("fix_gamma", True):
             gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+        # statistics in fp32 regardless of compute dtype: bf16 mean/var over
+        # large batches loses the small-difference precision BN depends on
+        data32 = data.astype(jnp.float32)
         use_global = attrs.get("use_global_stats", False) or not octx.is_train
         if use_global:
             mean, var = moving_mean, moving_var
             new_mm, new_mv = moving_mean, moving_var
         else:
-            mean = jnp.mean(data, axis=red)
-            var = jnp.var(data, axis=red)
+            mean = jnp.mean(data32, axis=red)
+            var = jnp.var(data32, axis=red)
             new_mm = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
             new_mv = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
         inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
-        out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
-        return [out, mean, var], [new_mm, new_mv]
+        out = (data32 - mean.reshape(bshape)) * inv \
+            * gamma.reshape(bshape).astype(jnp.float32) \
+            + beta.reshape(bshape).astype(jnp.float32)
+        return [out.astype(data.dtype), mean, var], [new_mm, new_mv]
 
     register_op(OpDef(
         "BatchNorm", _batchnorm, schema=bn_schema,
@@ -550,11 +555,17 @@ def _register_loss_heads():
         preserve = attrs.get("preserve_shape", False)
 
         def fwd_fn(d):
+            # normalize in fp32: exp/sum in bf16 would be the one numerically
+            # fragile spot in an otherwise-bf16 graph
+            d32 = d.astype(jnp.float32)
             if multi:
-                return jax.nn.softmax(d, axis=1)
-            if preserve:
-                return jax.nn.softmax(d, axis=-1)
-            return jax.nn.softmax(d.reshape(d.shape[0], -1), axis=-1).reshape(d.shape)
+                out = jax.nn.softmax(d32, axis=1)
+            elif preserve:
+                out = jax.nn.softmax(d32, axis=-1)
+            else:
+                out = jax.nn.softmax(d32.reshape(d.shape[0], -1),
+                                     axis=-1).reshape(d.shape)
+            return out.astype(d.dtype)
 
         @jax.custom_vjp
         def head(d, l):

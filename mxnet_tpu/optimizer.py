@@ -131,6 +131,35 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    # -- functional form, for the fused (donated, jitted) train step --------
+    def fused_kernel(self):
+        """Pure-functional form of this optimizer, traceable inside jax.jit.
+
+        Returns ``(make_slots, apply)`` or None when unsupported (Module then
+        falls back to the eager update path):
+
+        * ``make_slots(w)``: jnp weight -> tuple of jnp slot arrays
+        * ``apply(w, g, slots, lr, wd, rescale, clip)``: all-jnp update;
+          ``lr`` arrives already bias-corrected/scheduled (host-side, like
+          the eager ``update()``); ``rescale``/``clip`` are runtime scalars
+          so later mutation of ``self.rescale_grad`` etc. is honored without
+          recompiling (clip <= 0 means no clipping).
+        """
+        return None
+
+    def fused_hyper(self, names):
+        """Host-side per-step hyperparams for the fused step: bumps update
+        counts exactly as the eager path does and returns
+        ``(lrs, wds, rescale, clip)`` numpy arrays/scalars, one lr/wd per
+        name in ``names``."""
+        for name in names:
+            self._update_count(name)
+        lrs = np.array([self._get_lr(n) for n in names], np.float32)
+        wds = np.array([self._get_wd(n) for n in names], np.float32)
+        clip = np.float32(self.clip_gradient
+                          if self.clip_gradient is not None else -1.0)
+        return lrs, wds, np.float32(self.rescale_grad), clip
+
 
 register = Optimizer.register
 
@@ -194,6 +223,25 @@ class SGD(Optimizer):
         self._fused_fn = jax.jit(fused)
         return self._fused_fn
 
+    def fused_kernel(self):
+        import jax.numpy as jnp
+
+        momentum = self.momentum
+
+        def make_slots(w):
+            return (jnp.zeros_like(w),) if momentum != 0.0 else ()
+
+        def apply(w, g, slots, lr, wd, rescale, clip):
+            g = g * rescale
+            g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+            if momentum != 0.0:
+                (m,) = slots
+                m = momentum * m - lr * (g + wd * w)
+                return w + m, (m,)
+            return w - lr * (g + wd * w), ()
+
+        return make_slots, apply
+
     def update_multi(self, indices, weights, grads, states):
         for i in indices:
             self._update_count(i)
@@ -216,6 +264,26 @@ class SGD(Optimizer):
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (reference: :330)."""
+
+    def fused_kernel(self):
+        import jax.numpy as jnp
+
+        momentum = self.momentum
+
+        def make_slots(w):
+            return (jnp.zeros_like(w),) if momentum != 0.0 else ()
+
+        def apply(w, g, slots, lr, wd, rescale, clip):
+            g = g * rescale
+            g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+            g = g + wd * w
+            if momentum != 0.0:
+                (m,) = slots
+                m = momentum * m + g
+                return w - lr * (g + momentum * m), (m,)
+            return w - lr * g, ()
+
+        return make_slots, apply
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -304,6 +372,33 @@ class Adam(Optimizer):
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=weight.dtype))
 
+    def fused_kernel(self):
+        import jax.numpy as jnp
+
+        beta1, beta2, eps = self.beta1, self.beta2, self.epsilon
+
+        def make_slots(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def apply(w, g, slots, lr, wd, rescale, clip):
+            mean, var = slots
+            g = g * rescale
+            g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+            g = g + wd * w
+            mean = beta1 * mean + (1 - beta1) * g
+            var = beta2 * var + (1 - beta2) * jnp.square(g)
+            return w - lr * mean / (jnp.sqrt(var) + eps), (mean, var)
+
+        return make_slots, apply
+
+    def fused_hyper(self, names):
+        lrs, wds, rescale, clip = super().fused_hyper(names)
+        # fold the bias correction into lr host-side, as eager update() does
+        for i, name in enumerate(names):
+            t = self._index_update_count[name]
+            lrs[i] *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        return lrs, wds, rescale, clip
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         t = self._index_update_count[index]
@@ -331,6 +426,23 @@ class AdaGrad(Optimizer):
 
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context)
+
+    def fused_kernel(self):
+        import jax.numpy as jnp
+
+        eps = self.float_stable_eps
+
+        def make_slots(w):
+            return (jnp.zeros_like(w),)
+
+        def apply(w, g, slots, lr, wd, rescale, clip):
+            (h,) = slots
+            g = g * rescale
+            g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+            h = h + g * g
+            return w - lr * (g / jnp.sqrt(h + eps) + wd * w), (h,)
+
+        return make_slots, apply
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -363,6 +475,39 @@ class RMSProp(Optimizer):
                     zeros(weight.shape, weight.context),
                     zeros(weight.shape, weight.context))
         return (zeros(weight.shape, weight.context),)
+
+    def fused_kernel(self):
+        import jax.numpy as jnp
+
+        rho, mom, eps = self.gamma1, self.gamma2, self.epsilon
+        centered = self.centered
+        cw = self.clip_weights if self.clip_weights else -1.0
+
+        def make_slots(w):
+            n = 3 if centered else 1
+            return tuple(jnp.zeros_like(w) for _ in range(n))
+
+        def apply(w, g, slots, lr, wd, rescale, clip):
+            g = g * rescale
+            g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+            g = g + wd * w
+            if centered:
+                n, gbar, delta = slots
+                n = rho * n + (1 - rho) * jnp.square(g)
+                gbar = rho * gbar + (1 - rho) * g
+                delta = mom * delta - lr * g / jnp.sqrt(n - jnp.square(gbar) + eps)
+                w = w + delta
+                new_slots = (n, gbar, delta)
+            else:
+                (n,) = slots
+                n = rho * n + (1 - rho) * jnp.square(g)
+                w = w - lr * g / jnp.sqrt(n + eps)
+                new_slots = (n,)
+            if cw > 0:
+                w = jnp.clip(w, -cw, cw)
+            return w, new_slots
+
+        return make_slots, apply
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -482,7 +627,23 @@ class Updater:
     def set_states(self, states):
         import pickle
 
-        self.states = pickle.loads(states)
+        loaded = pickle.loads(states)
+        # fused-step payloads are keyed by param NAME with numpy-tuple
+        # values; translate via the optimizer's idx2name so a checkpoint
+        # saved on the fused path resumes on the eager one
+        name2idx = {n: i for i, n in self.optimizer.idx2name.items()}
+        converted = {}
+        for key, state in loaded.items():
+            idx = name2idx.get(key, key) if isinstance(key, str) else key
+            if isinstance(state, tuple) and all(
+                    isinstance(s, np.ndarray) for s in state):
+                import jax.numpy as jnp
+
+                arrays = [NDArray(jnp.asarray(s)) for s in state]
+                state = (None if not arrays else
+                         arrays[0] if len(arrays) == 1 else tuple(arrays))
+            converted[idx] = state
+        self.states = converted
 
     def get_states(self):
         import pickle

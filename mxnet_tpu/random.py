@@ -23,10 +23,14 @@ def _key():
 
 
 def seed(seed_state):
-    """Seed all random sources (reference: python/mxnet/random.py:34)."""
+    """Seed all random sources (reference: python/mxnet/random.py:34) —
+    the device-side key chain and the host-side numpy generator the
+    initializers draw from."""
     import jax
+    import numpy as np
 
     _state.key = jax.random.PRNGKey(int(seed_state))
+    np.random.seed(int(seed_state) % (2 ** 32))
 
 
 def split_key():

@@ -1,0 +1,250 @@
+"""CompiledTrainStep — the whole training step as ONE donated XLA program.
+
+TPU-native analog of the reference's bulk-exec segments
+(`src/executor/graph_executor.cc:678-756`), taken to its conclusion: where
+the reference fuses forward/backward node sequences into single engine ops
+but leaves the optimizer as separate per-parameter kernels
+(`python/mxnet/optimizer.py` dispatching `sgd_mom_update` etc.), here
+forward + backward + optimizer + aux-state update compile into a single
+``jax.jit`` with ``donate_argnums`` on parameters / optimizer slots / aux —
+XLA reuses their buffers in place, so the steady-state step does no
+allocation and no host round-trips.
+
+Mixed precision: master weights and optimizer slots stay float32 on device;
+when ``compute_dtype`` (e.g. bfloat16) is set, parameters and input data are
+cast once at program entry, the graph (matmuls/convs on the MXU) runs in the
+compute dtype, and gradients are cast back to float32 before the optimizer.
+Ops with precision-critical internals (BatchNorm statistics, softmax)
+compute in float32 regardless.
+
+State lives here as jax arrays, not NDArrays — Module flushes it back into
+the executor's NDArray buffers only at eval/checkpoint boundaries.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CompiledTrainStep"]
+
+
+class CompiledTrainStep:
+    def __init__(self, exec_group, optimizer, compute_dtype=None):
+        import jax.numpy as jnp
+
+        kernel = optimizer.fused_kernel()
+        if kernel is None:
+            raise MXNetError("optimizer %s has no fused kernel"
+                             % type(optimizer).__name__)
+        self._make_slots, self._opt_apply = kernel
+        self._optimizer = optimizer
+        self._group = exec_group
+        self._exec = exec_group.exec_
+
+        exe = self._exec
+        self._data_names = list(exec_group.data_names)
+        self._label_names = [n for n in exec_group.label_names
+                             if n in exe.arg_dict]
+        self._param_names = [n for n in exe._arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        # only params with a gradient request get optimizer updates; fixed
+        # params ride along as forward inputs
+        self._grad_names = [n for n in self._param_names
+                            if exe.grad_req.get(n, "null") == "write"]
+        unsupported = [n for n in self._param_names
+                       if exe.grad_req.get(n, "null") not in ("null", "write")]
+        if unsupported:
+            raise MXNetError("fused train step supports grad_req "
+                             "null/write only; got add for %s" % unsupported)
+        self._aux_names = list(exe._aux_names)
+
+        if compute_dtype in (None, "", "float32", np.float32):
+            self._cdtype = None
+        else:
+            self._cdtype = jnp.dtype(compute_dtype)
+
+        # own copies: the first donated step invalidates its input buffers,
+        # and the executor's NDArrays must keep theirs
+        self.params = {n: jnp.copy(exe.arg_dict[n].data)
+                       for n in self._param_names}
+        self.aux = {n: jnp.copy(exe.aux_dict[n].data) for n in self._aux_names}
+        self.slots = {n: self._make_slots(self.params[n])
+                      for n in self._grad_names}
+        self._fn = self._build()
+        self.num_steps = 0
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        exe = self._exec
+        cdtype = self._cdtype
+        data_names = self._data_names
+        grad_names = self._grad_names
+        aux_names = self._aux_names
+        opt_apply = self._opt_apply
+
+        def cast(v):
+            if cdtype is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                return v.astype(cdtype)
+            return v
+
+        def step(params, slots, aux, data, lrs, wds, rescale, clip, rng):
+            castp = {n: cast(v) for n, v in params.items()}
+            # labels keep their dtype (integer class ids beyond bf16's exact
+            # range must survive); only data inputs are cast
+            datac = {n: (cast(v) if n in data_names else v)
+                     for n, v in data.items()}
+
+            def fwd(gvals):
+                env = dict(castp)
+                env.update(zip(grad_names, gvals))
+                env.update(datac)
+                outs, new_aux = exe._run_graph(env, aux, rng, True)
+                return outs, [new_aux[n] for n in aux_names]
+
+            gvals = [castp[n] for n in grad_names]
+            outs, vjp_fn, new_aux_vals = jax.vjp(fwd, gvals, has_aux=True)
+            cts = [jnp.ones_like(o) for o in outs]
+            (grads,) = vjp_fn(cts)
+
+            new_params = dict(params)
+            new_slots = {}
+            for i, n in enumerate(grad_names):
+                g = grads[i].astype(params[n].dtype)
+                w, s = opt_apply(params[n], g, slots[n],
+                                 lrs[i], wds[i], rescale, clip)
+                new_params[n] = w
+                new_slots[n] = s
+            new_aux = {n: v.astype(aux[n].dtype)
+                       for n, v in zip(aux_names, new_aux_vals)}
+            return new_params, new_slots, new_aux, outs
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def run(self, data_batch):
+        """Execute one full training step; returns output jnp arrays."""
+        from . import random as _rnd
+
+        data = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            data[name] = self._place(arr, name)
+        if self._label_names and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                data[name] = self._place(arr, name)
+
+        lrs, wds, rescale, clip = self._optimizer.fused_hyper(self._grad_names)
+        rng = _rnd.split_key()
+        self.params, self.slots, self.aux, outs = self._fn(
+            self.params, self.slots, self.aux, data, lrs, wds, rescale, clip,
+            rng)
+        self.num_steps += 1
+        return outs
+
+    def _place(self, arr, name):
+        import jax
+
+        group = self._group
+        dst = self._exec.arg_dict.get(name)
+        v = arr.data
+        if dst is not None and v.dtype != dst.data.dtype:
+            v = v.astype(dst.data.dtype)
+        if group._mesh is not None:
+            return jax.device_put(v, group._data_sharding)
+        return jax.device_put(v, group.contexts[0].jax_device)
+
+    # ------------------------------------------------------------------
+    # state exchange with the NDArray world
+    # ------------------------------------------------------------------
+    def flush_to_executor(self):
+        """Write master params/aux back into the executor's NDArray buffers
+        (copies — the step will donate its own buffers next run)."""
+        import jax.numpy as jnp
+
+        exe = self._exec
+        for n in self._param_names:
+            exe.arg_dict[n]._set_data(
+                jnp.copy(self.params[n]).astype(exe.arg_dict[n].data.dtype))
+        for n in self._aux_names:
+            exe.aux_dict[n]._set_data(
+                jnp.copy(self.aux[n]).astype(exe.aux_dict[n].data.dtype))
+
+    def load_from_executor(self):
+        """Re-seed step state from the executor (after set_params etc.)."""
+        import jax.numpy as jnp
+
+        exe = self._exec
+        for n in self._param_names:
+            self.params[n] = jnp.copy(exe.arg_dict[n].data)
+        for n in self._aux_names:
+            self.aux[n] = jnp.copy(exe.aux_dict[n].data)
+
+    def get_states(self):
+        """Serialized optimizer slots (save_optimizer_states payload)."""
+        host = {n: tuple(np.asarray(s) for s in slots)
+                for n, slots in self.slots.items()}
+        return pickle.dumps(host)
+
+    def set_states(self, payload):
+        """Load optimizer slots.  Accepts both the fused format (keyed by
+        param name, numpy tuples) and the eager Updater format (keyed by the
+        param's index in the executor group, NDArray-valued)."""
+        import jax.numpy as jnp
+
+        host = pickle.loads(payload)
+        index_names = {i: n for i, n in enumerate(self._group.param_names)}
+        for key, state in host.items():
+            name = index_names.get(key, key) if isinstance(key, int) else key
+            if name not in self.slots:
+                continue
+            self.slots[name] = self._state_to_slots(state, jnp)
+
+    @staticmethod
+    def _state_to_slots(state, jnp):
+        """Eager create_state values -> fused slot tuple: None -> (),
+        single array -> 1-tuple, tuple -> tuple (NDArrays unwrapped)."""
+        def leaf(v):
+            return jnp.asarray(v.data if hasattr(v, "data") else v)
+
+        if state is None:
+            return ()
+        if isinstance(state, (tuple, list)):
+            return tuple(leaf(s) for s in state)
+        return (leaf(state),)
+
+    def import_updater_states(self, states, param_names):
+        """Seed slots from an eager Updater's state dict (index- or
+        name-keyed) when the module switches eager -> fused mid-training."""
+        import jax.numpy as jnp
+
+        index_names = {i: n for i, n in enumerate(param_names)}
+        for key, state in states.items():
+            name = index_names.get(key, key) if isinstance(key, int) else key
+            if name in self.slots:
+                self.slots[name] = self._state_to_slots(state, jnp)
+
+    def export_updater_states(self, updater, param_names, ctx):
+        """Hand the fused slots to an eager Updater (fused -> eager switch:
+        install_monitor, manual update() loop) so momentum carries over."""
+        import jax.numpy as jnp
+
+        from . import ndarray as _nd
+
+        for idx, name in enumerate(param_names):
+            if name not in self.slots:
+                continue
+            slots = self.slots[name]
+            arrays = [_nd.NDArray(jnp.copy(s), ctx) for s in slots]
+            if not arrays:
+                state = None
+            elif len(arrays) == 1:
+                state = arrays[0]
+            else:
+                state = tuple(arrays)
+            updater.states[idx] = state

@@ -1,0 +1,222 @@
+"""Fused (donated, jitted) train step: parity with the eager update path.
+
+Analog of the reference's expectation that bulk-exec segments change
+scheduling, not numerics (graph_executor.cc:678-756).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.io import DataBatch
+
+
+def _make_module(fused, optimizer="sgd", compute_dtype=None, seed=7):
+    from mxnet_tpu import config
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=4)
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype=compute_dtype)
+    mod.bind(data_shapes=[("data", (8, 10))], label_shapes=[("softmax_label", (8,))])
+    mx.random.seed(seed)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    import os
+
+    os.environ["MXNET_FUSED_TRAIN_STEP"] = "1" if fused else "0"
+    config.refresh("MXNET_FUSED_TRAIN_STEP")
+    mod.init_optimizer(optimizer=optimizer,
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                         "wd": 1e-4}
+                       if optimizer == "sgd" else {"learning_rate": 0.01})
+    os.environ["MXNET_FUSED_TRAIN_STEP"] = "1"
+    config.refresh("MXNET_FUSED_TRAIN_STEP")
+    return mod
+
+
+def _batches(n, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        x = nd.array(rng.uniform(-1, 1, (8, 10)).astype(np.float32))
+        y = nd.array(rng.randint(0, 4, (8,)).astype(np.float32))
+        out.append(DataBatch([x], [y]))
+    return out
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_fused_matches_eager(optimizer):
+    fused = _make_module(True, optimizer)
+    eager = _make_module(False, optimizer)
+    assert fused._fused_step is not None
+    assert eager._fused_step is None
+
+    for batch in _batches(5):
+        fused.forward_backward(batch)
+        fused.update()
+        eager.forward_backward(batch)
+        eager.update()
+
+    fargs, fauxs = fused.get_params()
+    eargs, eauxs = eager.get_params()
+    for name in fargs:
+        np.testing.assert_allclose(fargs[name].asnumpy(), eargs[name].asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_fused_outputs_feed_metric():
+    mod = _make_module(True)
+    metric = mx.metric.Accuracy()
+    for batch in _batches(3):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+    name, value = metric.get()
+    assert 0.0 <= value <= 1.0
+
+
+def test_fused_then_eval_forward_uses_fresh_params():
+    mod = _make_module(True)
+    batches = _batches(4)
+    for batch in batches:
+        mod.forward_backward(batch)
+        mod.update()
+    # eval forward must see post-update params, not the bind-time ones
+    mod.forward(batches[0], is_train=False)
+    out = mod.get_outputs()[0].asnumpy()
+    fresh = _make_module(True)
+    fresh.forward(batches[0], is_train=False)
+    out0 = fresh.get_outputs()[0].asnumpy()
+    assert not np.allclose(out, out0)
+
+
+def test_bf16_compute_trains():
+    mod = _make_module(True, compute_dtype="bfloat16")
+    assert mod._fused_step is not None
+    metric = mx.metric.CrossEntropy()
+    batches = _batches(2)
+    first = None
+    for i in range(30):
+        b = batches[i % 2]
+        mod.forward_backward(b)
+        mod.update()
+        metric.reset()
+        mod.update_metric(metric, b.label)
+        if first is None:
+            first = metric.get()[1]
+    last = metric.get()[1]
+    assert last < first  # loss decreased under bf16 compute
+
+
+def test_fused_optimizer_state_roundtrip(tmp_path):
+    mod = _make_module(True)
+    for batch in _batches(3):
+        mod.forward_backward(batch)
+        mod.update()
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    slots_before = {n: [np.asarray(s) for s in sl]
+                    for n, sl in mod._fused_step.slots.items()}
+    for batch in _batches(2, seed=11):
+        mod.forward_backward(batch)
+        mod.update()
+    mod.load_optimizer_states(fname)
+    for n, sl in mod._fused_step.slots.items():
+        for a, b in zip(sl, slots_before[n]):
+            np.testing.assert_allclose(np.asarray(a), b)
+
+
+def test_rescale_clip_are_runtime_scalars():
+    # mutating rescale_grad after compilation must take effect (ADVICE r1)
+    mod = _make_module(True)
+    batch = _batches(1)[0]
+    mod.forward_backward(batch)
+    mod.update()
+    p1 = {n: a.asnumpy().copy() for n, a in mod.get_params()[0].items()}
+    mod._optimizer.rescale_grad = 0.0  # freeze: grad contribution zeroed
+    mod._optimizer.wd = 0.0
+    mod._optimizer.momentum = 0.0
+    # rebuild kernel-free check: with rescale 0 and wd 0, only momentum moves
+    # params; run enough steps for momentum to decay to ~nothing first
+    for _ in range(60):
+        mod.forward_backward(batch)
+        mod.update()
+    p2 = {n: a.asnumpy().copy() for n, a in mod.get_params()[0].items()}
+    for n in p1:
+        # params drifted only by decayed momentum, not by fresh gradients
+        assert np.max(np.abs(p2[n] - p1[n])) < 1.0
+
+
+def test_fused_to_eager_handoff_preserves_momentum():
+    # install_monitor mid-training drops to the eager path; momentum must
+    # carry over so the trajectory matches a pure-eager run
+    fused = _make_module(True)
+    eager = _make_module(False)
+    batches = _batches(6)
+    for b in batches[:3]:
+        fused.forward_backward(b)
+        fused.update()
+        eager.forward_backward(b)
+        eager.update()
+
+    class _NullMon:
+        def install(self, exe):
+            pass
+
+    fused.install_monitor(_NullMon())
+    assert fused._fused_step is None
+    for b in batches[3:]:
+        fused.forward_backward(b)
+        fused.update()
+        eager.forward_backward(b)
+        eager.update()
+    fargs = fused.get_params()[0]
+    eargs = eager.get_params()[0]
+    for name in fargs:
+        np.testing.assert_allclose(fargs[name].asnumpy(), eargs[name].asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_reinit_optimizer_keeps_trained_params():
+    mod = _make_module(True)
+    for b in _batches(3):
+        mod.forward_backward(b)
+        mod.update()
+    trained = {n: a.asnumpy().copy() for n, a in mod.get_params()[0].items()}
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01},
+                       force_init=True)
+    now = {n: a.asnumpy().copy() for n, a in mod.get_params()[0].items()}
+    for n in trained:
+        np.testing.assert_allclose(now[n], trained[n], err_msg=n)
+
+
+def test_cross_format_state_load(tmp_path):
+    # save on the fused path, load on the eager path (and back)
+    fused = _make_module(True)
+    for b in _batches(3):
+        fused.forward_backward(b)
+        fused.update()
+    f = str(tmp_path / "f.states")
+    fused.save_optimizer_states(f)
+
+    eager = _make_module(False)
+    for b in _batches(1):
+        eager.forward_backward(b)
+        eager.update()
+    eager.load_optimizer_states(f)
+    # momentum slot for fc1_weight should equal the fused one
+    idx = eager._exec_group.param_names.index("fc1_weight")
+    m_eager = eager._updater.states[idx].asnumpy()
+    m_fused = np.asarray(fused._fused_step.slots["fc1_weight"][0])
+    np.testing.assert_allclose(m_eager, m_fused, rtol=1e-6)
+
+    e = str(tmp_path / "e.states")
+    eager.save_optimizer_states(e)
+    fused.load_optimizer_states(e)
+    np.testing.assert_allclose(
+        np.asarray(fused._fused_step.slots["fc1_weight"][0]), m_fused,
+        rtol=1e-6)
