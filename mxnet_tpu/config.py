@@ -101,14 +101,8 @@ register("MXNET_EXEC_BULK_EXEC_INFERENCE", bool, True,
 register("MXNET_BACKWARD_DO_MIRROR", bool, False,
          "Trade compute for memory by rematerializing activations in the "
          "backward pass via jax.checkpoint (reference env_var.md mirror).")
-register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
-         "Arrays larger than this many elements are treated as 'big' by the "
-         "kvstore sharding heuristics (reference kvstore_dist.h:276).")
 register("MXNET_ENGINE_TYPE", str, "",
          "Set to 'NaiveEngine' to force eager, per-op execution for "
          "debugging (reference src/engine/engine.cc:13-39).")
 register("MXNET_PROFILER_AUTOSTART", bool, False,
          "Start the profiler at import time (reference env_var.md:71-79).")
-register("MXNET_CPU_WORKER_NTHREADS", int, 1,
-         "Worker threads for host-side data-pipeline work (decode, augment); "
-         "device scheduling itself is XLA's (reference: engine CPU pool).")

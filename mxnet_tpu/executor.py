@@ -96,11 +96,29 @@ class Executor:
     # ------------------------------------------------------------------
     # graph execution as a pure function
     # ------------------------------------------------------------------
-    def _run_graph(self, env_args, env_aux, rng, is_train):
-        """Topologically execute the node DAG on jnp values."""
+    def _run_graph(self, env_args, env_aux, rng, is_train, tap=None):
+        """Topologically execute the node DAG on jnp values.
+
+        ``tap(name, value)``, when given, is invoked with every node
+        output — the analog of the reference's per-node monitor callback
+        (`graph_executor.cc:758-778`).  Taps only make sense outside jit
+        (eager execution), where intermediate values are materialized.
+        """
         import jax
 
+        from . import profiler as _prof
+
         sym = self._symbol
+        # per-node profiler spans are only meaningful when executing
+        # eagerly on concrete values (under jit this loop runs once, at
+        # trace time); XLA-side op attribution comes from named_scope
+        spans = False
+        if _prof.is_running():
+            probe = next(iter(env_args.values()), None)
+            try:
+                spans = not isinstance(probe, jax.core.Tracer)
+            except AttributeError:
+                spans = False
         values = {}
         new_aux = dict(env_aux)
         for seq, node in enumerate(sym._topo()):
@@ -116,13 +134,73 @@ class Executor:
             aux_ins = [values[(id(s), i)] for s, i in node.inputs[n_args:]]
             octx = OpContext(is_train=is_train,
                              rng=jax.random.fold_in(rng, seq) if rng is not None else None)
-            outs, node_new_aux = node.op.fcompute(attrs, ins, aux_ins, octx)
+            with jax.named_scope(node.name):
+                if spans:
+                    with _prof.Scope(node.name):
+                        outs, node_new_aux = node.op.fcompute(
+                            attrs, ins, aux_ins, octx)
+                else:
+                    outs, node_new_aux = node.op.fcompute(
+                        attrs, ins, aux_ins, octx)
             for i, o in enumerate(outs):
                 values[(id(node), i)] = o
+            if tap is not None:
+                onames = node.op.list_outputs(attrs)
+                for i in range(node.op.n_visible_outputs(attrs)):
+                    suffix = onames[i] if i < len(onames) else str(i)
+                    tap("%s_%s" % (node.name, suffix), outs[i])
             for (anode, _), val in zip(node.inputs[n_args:], node_new_aux):
                 new_aux[anode.name] = val
         outputs = [values[(id(n), i)] for n, i in sym._outputs]
         return outputs, new_aux
+
+    def _fwd_impl(self, arg_vals, aux_vals, rng, is_train, tap=None):
+        env_args = dict(zip(self._arg_names, arg_vals))
+        env_aux = dict(zip(self._aux_names, aux_vals))
+        outs, new_aux = self._run_graph(env_args, env_aux, rng, is_train, tap)
+        return outs, [new_aux[n] for n in self._aux_names]
+
+    def _combined_impl(self, arg_vals, aux_vals, old_grads, head_grads, rng,
+                       tap=None):
+        import jax
+
+        from . import config as _config
+
+        grad_names = self._grad_names
+        arg_names = self._arg_names
+        aux_names = self._aux_names
+        reqs = self.grad_req
+        env_aux_in = dict(zip(aux_names, aux_vals))
+        nograd = {n: v for n, v in zip(arg_names, arg_vals)
+                  if n not in set(grad_names)}
+
+        def fwd(gvals):
+            env_args = dict(nograd)
+            env_args.update(zip(grad_names, gvals))
+            outs, new_aux = self._run_graph(env_args, env_aux_in, rng, True,
+                                            tap)
+            return outs, [new_aux[n] for n in aux_names]
+
+        if tap is None and _config.get("MXNET_BACKWARD_DO_MIRROR"):
+            # memonger analog: rematerialize activations in the backward
+            # pass instead of keeping them live (reference mirror option)
+            fwd = jax.checkpoint(fwd)
+        gvals = [v for n, v in zip(arg_names, arg_vals) if n in set(grad_names)]
+        outs, vjp_fn, new_aux = jax.vjp(fwd, gvals, has_aux=True)
+        if head_grads is None:
+            import jax.numpy as jnp
+
+            cts = [jnp.ones_like(o) for o in outs]
+        else:
+            cts = list(head_grads)
+        (grads,) = vjp_fn(cts)
+        out_grads = []
+        for gname, g in zip(grad_names, grads):
+            if reqs[gname] == "add":
+                out_grads.append(old_grads[grad_names.index(gname)] + g)
+            else:
+                out_grads.append(g)
+        return outs, new_aux, out_grads
 
     def _get_fn(self, kind):
         """kind: 'fwd_test' | 'fwd_train' | 'combined'"""
@@ -131,51 +209,28 @@ class Executor:
             return fn
         import jax
 
-        grad_names = self._grad_names
-        arg_names = self._arg_names
-        aux_names = self._aux_names
-        reqs = self.grad_req
+        from . import config as _config
+
+        # MXNET_ENGINE_TYPE=NaiveEngine: run everything eagerly op-by-op
+        # (the reference's debugging engine); bulk-exec-inference off does
+        # the same for inference graphs only
+        compiled = _config.get("MXNET_ENGINE_TYPE") != "NaiveEngine"
+        if kind == "fwd_test" and not _config.get("MXNET_EXEC_BULK_EXEC_INFERENCE"):
+            compiled = False
 
         if kind in ("fwd_test", "fwd_train"):
             is_train = kind == "fwd_train"
 
             def run(arg_vals, aux_vals, rng):
-                env_args = dict(zip(arg_names, arg_vals))
-                env_aux = dict(zip(aux_names, aux_vals))
-                outs, new_aux = self._run_graph(env_args, env_aux, rng, is_train)
-                return outs, [new_aux[n] for n in aux_names]
+                return self._fwd_impl(arg_vals, aux_vals, rng, is_train)
 
-            fn = jax.jit(run)
+            fn = jax.jit(run) if compiled else run
         else:
             def combined(arg_vals, aux_vals, old_grads, head_grads, rng):
-                env_aux_in = dict(zip(aux_names, aux_vals))
-                nograd = {n: v for n, v in zip(arg_names, arg_vals)
-                          if n not in set(grad_names)}
+                return self._combined_impl(arg_vals, aux_vals, old_grads,
+                                           head_grads, rng)
 
-                def fwd(gvals):
-                    env_args = dict(nograd)
-                    env_args.update(zip(grad_names, gvals))
-                    outs, new_aux = self._run_graph(env_args, env_aux_in, rng, True)
-                    return outs, [new_aux[n] for n in aux_names]
-
-                gvals = [v for n, v in zip(arg_names, arg_vals) if n in set(grad_names)]
-                outs, vjp_fn, new_aux = jax.vjp(fwd, gvals, has_aux=True)
-                if head_grads is None:
-                    import jax.numpy as jnp
-
-                    cts = [jnp.ones_like(o) for o in outs]
-                else:
-                    cts = list(head_grads)
-                (grads,) = vjp_fn(cts)
-                out_grads = []
-                for gname, g in zip(grad_names, grads):
-                    if reqs[gname] == "add":
-                        out_grads.append(old_grads[grad_names.index(gname)] + g)
-                    else:
-                        out_grads.append(g)
-                return outs, new_aux, out_grads
-
-            fn = jax.jit(combined)
+            fn = jax.jit(combined) if compiled else combined
         self._fn_cache[kind] = fn
         return fn
 
@@ -198,22 +253,42 @@ class Executor:
         rng = _rnd.split_key()
         self._last_rng = rng  # reused by backward(out_grads): same dropout masks
 
+        tap = None
+        if self._monitor_callback is not None:
+            # monitored runs execute eagerly (the NaiveEngine analog) so
+            # every op's output exists to be observed — reference taps each
+            # node in graph_executor.cc:758-778
+            cb = self._monitor_callback
+
+            def tap(name, value):
+                cb(name, nd.NDArray(value, self._ctx))
+
+        from . import profiler as _prof
+
         if is_train and self._grad_names:
-            fn = self._get_fn("combined")
             old_grads = [self.grad_dict[n].data for n in self._grad_names]
-            outs, new_aux, grads = fn(arg_vals, aux_vals, old_grads, None, rng)
+            if tap is not None:
+                # vjp tracing would hand the tap abstract tracers, so the
+                # observation pass runs separately on concrete values
+                self._fwd_impl(arg_vals, aux_vals, rng, True, tap)
+            with _prof.Scope("forward_backward", "executor"):
+                outs, new_aux, grads = self._get_fn("combined")(
+                    arg_vals, aux_vals, old_grads, None, rng)
             self._cached_grads = grads
         else:
-            fn = self._get_fn("fwd_train" if is_train else "fwd_test")
-            outs, new_aux = fn(arg_vals, aux_vals, rng)
+            if tap is not None:
+                outs, new_aux = self._fwd_impl(arg_vals, aux_vals, rng,
+                                               is_train, tap)
+            else:
+                with _prof.Scope("forward", "executor"):
+                    outs, new_aux = self._get_fn(
+                        "fwd_train" if is_train else "fwd_test")(
+                        arg_vals, aux_vals, rng)
             self._cached_grads = None
         for n, v in zip(self._aux_names, new_aux):
             self.aux_dict[n]._set_data(v)
         self._outputs = [nd.NDArray(o, self._ctx) for o in outs]
         self.outputs_ready = True
-        if self._monitor_callback is not None:
-            for name, arr in zip(self._symbol.list_outputs(), self._outputs):
-                self._monitor_callback(name, arr)
         return self._outputs
 
     def backward(self, out_grads=None):

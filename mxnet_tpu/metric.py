@@ -1,318 +1,263 @@
-"""Evaluation metrics (reference: python/mxnet/metric.py, 490 LoC)."""
+"""Evaluation metrics.
+
+API-parity surface of the reference's ``python/mxnet/metric.py`` (class
+names, constructor signatures, ``get``/``get_name_value`` protocol), built
+around a different core: every metric reduces one (label, pred) pair to a
+``(statistic_sum, instance_count)`` tuple in a single vectorized numpy
+expression (``_batch``), and the base class owns pairing, accumulation and
+reporting.  No per-row Python loops — metric cost stays negligible next to
+the compiled step even for large batches.
+"""
 from __future__ import annotations
 
-import math
-
 import numpy as np
-
-from .base import MXNetError
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity",
            "MAE", "MSE", "RMSE", "CrossEntropy", "Loss", "Torch", "Caffe",
            "CustomMetric", "CompositeEvalMetric", "np_metric", "create"]
 
 
+def _host(x):
+    """Materialize an NDArray / jax array / numpy array on the host."""
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
 def check_label_shapes(labels, preds, shape=0):
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels %s does not match shape of predictions %s"
-                         % (label_shape, pred_shape))
+    la = len(labels) if shape == 0 else labels.shape
+    pr = len(preds) if shape == 0 else preds.shape
+    if la != pr:
+        raise ValueError(
+            "Shape of labels %s does not match shape of predictions %s"
+            % (la, pr))
 
 
 class EvalMetric:
-    """Base metric (reference: metric.py:14)."""
+    """Accumulating metric base.  Subclasses implement ``_batch(label,
+    pred) -> (sum, count)`` over host arrays; everything else lives here."""
 
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
         self.reset()
 
-    def update(self, labels, preds):
+    def reset(self):
+        n = 1 if self.num is None else self.num
+        self._sums = [0.0] * n
+        self._counts = [0] * n
+
+    # reference-compatible attribute views (Module/callbacks poke these)
+    @property
+    def sum_metric(self):
+        return self._sums[0] if self.num is None else self._sums
+
+    @sum_metric.setter
+    def sum_metric(self, v):
+        if self.num is None:
+            self._sums[0] = v
+        else:
+            self._sums = list(v)
+
+    @property
+    def num_inst(self):
+        return self._counts[0] if self.num is None else self._counts
+
+    @num_inst.setter
+    def num_inst(self, v):
+        if self.num is None:
+            self._counts[0] = v
+        else:
+            self._counts = list(v)
+
+    def _batch(self, label, pred):
         raise NotImplementedError()
 
-    def reset(self):
-        if self.num is None:
-            self.num_inst = 0
-            self.sum_metric = 0.0
-        else:
-            self.num_inst = [0] * self.num
-            self.sum_metric = [0.0] * self.num
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for slot, (label, pred) in enumerate(zip(labels, preds)):
+            s, n = self._batch(_host(label), _host(pred))
+            idx = 0 if self.num is None else slot
+            self._sums[idx] += s
+            self._counts[idx] += n
 
     def get(self):
+        def ratio(s, n):
+            return s / n if n != 0 else float("nan")
+
         if self.num is None:
-            if self.num_inst == 0:
-                return (self.name, float("nan"))
-            return (self.name, self.sum_metric / self.num_inst)
-        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
-        values = [x / y if y != 0 else float("nan")
-                  for x, y in zip(self.sum_metric, self.num_inst)]
-        return (names, values)
+            return (self.name, ratio(self._sums[0], self._counts[0]))
+        return (["%s_%d" % (self.name, i) for i in range(self.num)],
+                [ratio(s, n) for s, n in zip(self._sums, self._counts)])
 
     def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names, values = self.get()
+        if not isinstance(names, list):
+            names, values = [names], [values]
+        return list(zip(names, values))
 
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
 
-class CompositeEvalMetric(EvalMetric):
-    """Manage multiple metrics (reference: metric.py:74)."""
-
-    def __init__(self, metrics=None, **kwargs):
-        super().__init__("composite", **kwargs)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(m) if isinstance(m, str) else m for m in metrics]
-
-    def add(self, metric):
-        self.metrics.append(create(metric) if isinstance(metric, str) else metric)
-
-    def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            raise ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
-
-    def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
-
-    def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
-
-    def get(self):
-        names = []
-        results = []
-        for metric in self.metrics:
-            result = metric.get()
-            names.append(result[0])
-            results.append(result[1])
-        return (names, results)
-
-
 class Accuracy(EvalMetric):
-    """Classification accuracy (reference: metric.py:129)."""
+    """Fraction of correctly classified instances."""
 
     def __init__(self, axis=1):
         super().__init__("accuracy")
         self.axis = axis
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred = pred_label.asnumpy()
-            if pred.shape != label.shape:
-                pred_lab = np.argmax(pred, axis=self.axis)
-            else:
-                pred_lab = pred.astype("int32")
-            lab = label.asnumpy().astype("int32")
-            check_label_shapes(lab, pred_lab)
-            self.sum_metric += (pred_lab.flat == lab.flat).sum()
-            self.num_inst += len(pred_lab.flat)
+    def _batch(self, label, pred):
+        hard = pred if pred.shape == label.shape \
+            else np.argmax(pred, axis=self.axis)
+        check_label_shapes(label, hard, shape=1)
+        eq = hard.astype("int64").ravel() == label.astype("int64").ravel()
+        return int(eq.sum()), eq.size
 
 
 class TopKAccuracy(EvalMetric):
-    """Top-k accuracy (reference: metric.py:152)."""
+    """Label-in-top-k accuracy.  Uses an O(n) partial partition of the
+    class axis rather than a full sort."""
 
     def __init__(self, top_k=1):
-        super().__init__("top_k_accuracy")
+        assert top_k > 1, "use Accuracy for top_k <= 1"
+        super().__init__("top_k_accuracy_%d" % top_k)
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred = np.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            lab = label.asnumpy().astype("int32")
-            check_label_shapes(lab, pred)
-            num_samples = pred.shape[0]
-            num_dims = len(pred.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred.flat == lab.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (pred[:, num_classes - 1 - j].flat == lab.flat).sum()
-            self.num_inst += num_samples
+    def _batch(self, label, pred):
+        assert pred.ndim <= 2, "predictions must be at most (batch, classes)"
+        if pred.ndim == 1:  # already-hard class ids
+            eq = pred.astype("int64") == label.astype("int64").ravel()
+            return int(eq.sum()), eq.size
+        k = min(self.top_k, pred.shape[1])
+        topk = np.argpartition(pred, -k, axis=1)[:, -k:]
+        hits = (topk == label.astype("int64")[:, None]).any(axis=1)
+        return int(hits.sum()), hits.size
 
 
 class F1(EvalMetric):
-    """Binary-classification F1 (reference: metric.py:189)."""
+    """Binary F1 from a vectorized confusion-matrix count per batch."""
 
     def __init__(self):
         super().__init__("f1")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = np.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(np.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            true_positives, false_positives, false_negatives = 0.0, 0.0, 0.0
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.0
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.0
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.0
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.0
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.0
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.0
-            self.sum_metric += f1_score
-            self.num_inst += 1
+    def _batch(self, label, pred):
+        y = label.astype("int64").ravel()
+        if np.unique(y).size > 2:
+            raise ValueError("F1 currently only supports binary classification.")
+        yhat = np.argmax(pred, axis=1).ravel()
+        tp = int(np.sum((yhat == 1) & (y == 1)))
+        fp = int(np.sum((yhat == 1) & (y == 0)))
+        fn = int(np.sum((yhat == 0) & (y == 1)))
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if precision + recall else 0.0
+        return f1, 1
 
 
 class Perplexity(EvalMetric):
-    """Perplexity (reference: metric.py:252)."""
+    """exp(mean negative log-prob of the target tokens)."""
 
     def __init__(self, ignore_label, axis=-1):
         super().__init__("Perplexity")
         self.ignore_label = ignore_label
         self.axis = axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.as_in_context(pred.context).reshape((label.size,))
-            label_np = label.asnumpy().astype("int32")
-            pred_np = pred.asnumpy().reshape(-1, pred.shape[-1])
-            probs = pred_np[np.arange(label_np.shape[0]), label_np]
-            if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label).astype(probs.dtype)
-                num -= int(np.sum(ignore))
-                probs = probs * (1 - ignore) + ignore
-            loss -= np.sum(np.log(np.maximum(1e-10, probs)))
-            num += label_np.shape[0]
-        self.sum_metric += np.exp(loss / num) if num > 0 else float("nan")
-        self.num_inst += 1
+    def _batch(self, label, pred):
+        flat = pred.reshape(-1, pred.shape[self.axis])
+        ids = label.astype("int64").ravel()
+        assert ids.size == flat.shape[0], \
+            "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+        p = np.take_along_axis(flat, ids[:, None], axis=1)[:, 0]
+        keep = np.ones_like(p, dtype=bool) if self.ignore_label is None \
+            else ids != self.ignore_label
+        nll = -np.log(np.maximum(p[keep], 1e-10)).sum()
+        count = int(keep.sum())
+        return float(np.exp(nll / count)) if count else float("nan"), 1
 
 
-class MAE(EvalMetric):
+class _Regression(EvalMetric):
+    """Shared shape handling for elementwise regression errors."""
+
+    def _batch(self, label, pred):
+        if label.ndim == 1:
+            label = label[:, None]
+        return float(self._error(label, pred)), 1
+
+
+class MAE(_Regression):
     def __init__(self):
         super().__init__("mae")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += np.abs(label - pred).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _error(label, pred):
+        return np.mean(np.abs(label - pred))
 
 
-class MSE(EvalMetric):
+class MSE(_Regression):
     def __init__(self):
         super().__init__("mse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _error(label, pred):
+        return np.mean(np.square(label - pred))
 
 
-class RMSE(EvalMetric):
+class RMSE(_Regression):
     def __init__(self):
         super().__init__("rmse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += np.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    @staticmethod
+    def _error(label, pred):
+        return np.sqrt(np.mean(np.square(label - pred)))
 
 
 class CrossEntropy(EvalMetric):
-    """Cross-entropy of predicted probabilities (reference: metric.py:386)."""
+    """Mean negative log predicted probability of the true class."""
 
     def __init__(self, eps=1e-8):
         super().__init__("cross-entropy")
         self.eps = eps
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[np.arange(label.shape[0]), np.int64(label)]
-            self.sum_metric += (-np.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def _batch(self, label, pred):
+        ids = label.astype("int64").ravel()
+        assert ids.size == pred.shape[0]
+        p = np.take_along_axis(pred, ids[:, None], axis=1)[:, 0]
+        return float(-np.log(p + self.eps).sum()), ids.size
 
 
 class Loss(EvalMetric):
-    """Mean of the raw outputs (for MakeLoss nets)."""
+    """Mean of raw outputs (MakeLoss-style nets); ignores labels."""
 
     def __init__(self):
         super().__init__("loss")
 
     def update(self, _, preds):
         for pred in preds:
-            self.sum_metric += np.sum(pred.asnumpy())
-            self.num_inst += pred.size
+            arr = _host(pred)
+            self._sums[0] += float(arr.sum())
+            self._counts[0] += arr.size
 
 
 class Torch(Loss):
     def __init__(self, name="torch"):
-        super(Loss, self).__init__(name)
+        EvalMetric.__init__(self, name)
 
 
 class Caffe(Loss):
     def __init__(self, name="caffe"):
-        super(Loss, self).__init__(name)
+        EvalMetric.__init__(self, name)
 
 
 class CustomMetric(EvalMetric):
-    """Metric from a feval function (reference: metric.py:431)."""
+    """Wrap a ``feval(label, pred)`` numpy function.  feval may return a
+    scalar (counted as one instance) or a (sum, count) pair."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name)
         self._feval = feval
@@ -321,52 +266,77 @@ class CustomMetric(EvalMetric):
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.num_inst += 1
+        for label, pred in zip(labels, preds):
+            result = self._feval(_host(label), _host(pred))
+            s, n = result if isinstance(result, tuple) else (result, 1)
+            self._sums[0] += s
+            self._counts[0] += n
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Fan one update out to several child metrics."""
+
+    def __init__(self, metrics=None, **kwargs):
+        super().__init__("composite", **kwargs)
+        self.metrics = []
+        for m in metrics or []:
+            self.add(m)
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str)
+                            else metric)
+
+    def get_metric(self, index):
+        if not 0 <= index < len(self.metrics):
+            raise ValueError("Metric index {} is out of range 0 and {}"
+                             .format(index, len(self.metrics)))
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        pairs = [m.get() for m in self.metrics]
+        return [p[0] for p in pairs], [p[1] for p in pairs]
 
 
 def np_metric(name=None, allow_extra_outputs=False):
-    """Decorator creating a custom metric from a numpy function."""
+    """Decorator turning a numpy feval into a CustomMetric."""
 
-    def feval(numpy_feval):
-        feval_name = name if name is not None else numpy_feval.__name__
-        return CustomMetric(numpy_feval, feval_name, allow_extra_outputs)
+    def wrap(numpy_feval):
+        return CustomMetric(numpy_feval, name or numpy_feval.__name__,
+                            allow_extra_outputs)
 
-    return feval
+    return wrap
 
 
-np = np  # keep numpy accessible as metric.np per reference convention
+_BY_NAME = {
+    "acc": Accuracy, "accuracy": Accuracy,
+    "ce": CrossEntropy, "cross-entropy": CrossEntropy,
+    "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
+    "top_k_accuracy": TopKAccuracy, "topkaccuracy": TopKAccuracy,
+    "loss": Loss, "torch": Torch, "caffe": Caffe, "perplexity": Perplexity,
+}
 
 
 def create(metric, **kwargs):
-    """Create metric by name or callable (reference: metric.py:478)."""
-    if callable(metric):
-        return CustomMetric(metric)
+    """Resolve a metric from a name, callable, list, or instance."""
     if isinstance(metric, EvalMetric):
         return metric
+    if callable(metric):
+        return CustomMetric(metric)
     if isinstance(metric, list):
-        composite = CompositeEvalMetric()
-        for child in metric:
-            composite.add(create(child, **kwargs))
-        return composite
-    metrics = {
-        "acc": Accuracy, "accuracy": Accuracy, "ce": CrossEntropy,
-        "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
-        "top_k_accuracy": TopKAccuracy, "topkaccuracy": TopKAccuracy,
-        "loss": Loss, "torch": Torch, "caffe": Caffe,
-        "perplexity": Perplexity,
-    }
-    try:
-        return metrics[metric.lower()](**kwargs)
-    except KeyError:
-        raise ValueError("Metric must be either callable or in {}".format(
-            sorted(metrics.keys())))
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, **kwargs))
+        return out
+    klass = _BY_NAME.get(str(metric).lower())
+    if klass is None:
+        raise ValueError("Metric must be either callable or in {}"
+                         .format(sorted(_BY_NAME)))
+    return klass(**kwargs)

@@ -1,9 +1,11 @@
 """BaseModule — the high-level train/eval/predict interface.
 
-Reference: `python/mxnet/module/base_module.py` (fit:368-519, score, predict,
-forward_backward).  The train loop is identical in shape: bind →
-init_params → init_optimizer → per batch forward_backward + update +
-update_metric, with epoch checkpoints and eval passes.
+API parity with the reference's ``python/mxnet/module/base_module.py``
+(fit/score/predict/forward_backward and the abstract surface below), with
+the training loop rebuilt around this framework's compiled-step execution
+model: ``fit`` is a thin driver over ``_fit_epoch``, and evaluation /
+prediction share one padded-batch iterator helper instead of three copies
+of the reset/limit/pad logic.
 """
 from __future__ import annotations
 
@@ -13,16 +15,21 @@ from collections import namedtuple
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
-from ..base import MXNetError
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 
-def _as_list(obj):
-    if isinstance(obj, list):
-        return obj
-    return [obj]
+def _callbacks(cb):
+    """Normalize a callback argument to an iterable."""
+    if cb is None:
+        return ()
+    return cb if isinstance(cb, (list, tuple)) else (cb,)
+
+
+def _fire(cbs, *args):
+    for cb in _callbacks(cbs):
+        cb(*args)
 
 
 class BaseModule:
@@ -43,73 +50,100 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
-    def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
-              score_end_callback=None, reset=True, epoch=0):
-        """Evaluate (reference: base_module.py:176)."""
+    def _eval_batches(self, eval_data, num_batch, reset):
+        """Yield (nbatch, batch) honoring the batch limit; resets first."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                return
+            yield nbatch, batch
+
+    @staticmethod
+    def _unpadded(batch, outputs):
+        """Strip the iterator's tail padding from a batch's outputs."""
+        n = outputs[0].shape[0] - batch.pad
+        return [out[:n] for out in outputs]
+
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0):
+        """Run an evaluation pass, returning the metric's name/value list."""
+        eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+        nbatch = -1
+        for nbatch, batch in self._eval_batches(eval_data, num_batch, reset):
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch, nbatch, eval_metric, locals()))
+        _fire(score_end_callback,
+              BatchEndParam(epoch, nbatch + 1, eval_metric, locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        """Generator over (outputs, nbatch, batch) with padding stripped."""
+        for nbatch, batch in self._eval_batches(eval_data, num_batch, reset):
+            self.forward(batch, is_train=False)
+            yield (self._unpadded(batch, self.get_outputs()), nbatch, batch)
 
-    def predict(self, eval_data, num_batch=None, merge_batches=True, reset=True,
-                always_output_list=False):
-        """Run prediction, collecting outputs (reference: base_module.py:253)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy() for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches: mismatched number of outputs"
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        """Collect forward outputs over a dataset.  With ``merge_batches``
+        the per-batch output lists are concatenated along axis 0."""
+        collected = [list(outs) for outs, _, _
+                     in self.iter_predict(eval_data, num_batch, reset)]
+        if not collected or not merge_batches:
+            return collected
+        widths = {len(outs) for outs in collected}
+        if len(widths) != 1:
+            raise ValueError("Cannot merge batches: mismatched number of outputs")
+        merged = [nd.concatenate([outs[i] for outs in collected])
+                  for i in range(widths.pop())]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
+
+    # ------------------------------------------------------------------
+    # Training
+    # ------------------------------------------------------------------
+    def prepare_fit(self, train_data, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_rebind=False,
+                    force_init=False, kvstore="local", optimizer="sgd",
+                    optimizer_params=(("learning_rate", 0.01),), monitor=None):
+        """Bind + init params + init optimizer for training on
+        ``train_data``'s shapes.  Split out of fit() so custom loops can
+        reuse the setup."""
+        from ..initializer import Uniform
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+
+    def _fit_epoch(self, epoch, train_data, eval_metric, batch_end_callback,
+                   monitor):
+        """One pass over train_data; returns the wall-clock cost."""
+        start = time.time()
+        eval_metric.reset()
+        for nbatch, batch in enumerate(train_data):
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            self.update_metric(eval_metric, batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch, nbatch, eval_metric, locals()))
+        return time.time() - start
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -117,76 +151,77 @@ class BaseModule:
             eval_end_callback=None, eval_batch_end_callback=None,
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None):
-        """Train (reference: base_module.py:368)."""
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        """Train for ``num_epoch`` epochs: compiled train steps per batch,
+        optional validation pass and checkpoints per epoch."""
         assert num_epoch is not None, "please specify number of epochs"
-        from ..initializer import Uniform
+        self.prepare_fit(train_data, initializer=initializer,
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing,
+                         force_rebind=force_rebind, force_init=force_init,
+                         kvstore=kvstore, optimizer=optimizer,
+                         optimizer_params=optimizer_params, monitor=monitor)
+        eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
 
-        if initializer is None:
-            initializer = Uniform(0.01)
-
-        self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
-        if monitor is not None:
-            self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
-
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-
-        ################################################################
-        # training loop
-        ################################################################
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-
+            cost = self._fit_epoch(epoch, train_data, eval_metric,
+                                   batch_end_callback, monitor)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, cost)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            # materialize params host-side once per epoch: checkpoints and
+            # user callbacks observe a consistent snapshot
+            arg_snap, aux_snap = self.get_params()
+            self.set_params(arg_snap, aux_snap)
+            _fire(epoch_end_callback, epoch, self.symbol, arg_snap, aux_snap)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
             train_data.reset()
 
     # ------------------------------------------------------------------
-    # Symbol information
+    # Parameter persistence
     # ------------------------------------------------------------------
+    def save_params(self, fname):
+        arg_params, aux_params = self.get_params()
+        blob = {"arg:%s" % k: v for k, v in arg_params.items()}
+        blob.update({"aux:%s" % k: v for k, v in aux_params.items()})
+        nd.save(fname, blob)
+
+    def load_params(self, fname):
+        arg_params, aux_params = {}, {}
+        for key, value in nd.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
+                arg_params[name] = value
+            elif kind == "aux":
+                aux_params[name] = value
+            else:
+                raise ValueError("Invalid param file " + fname)
+        self.set_params(arg_params, aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # ------------------------------------------------------------------
+    # Abstract surface (implemented by Module / BucketingModule / ...)
+    # ------------------------------------------------------------------
+    @property
+    def symbol(self):
+        return self._symbol
+
     @property
     def data_names(self):
         raise NotImplementedError()
@@ -195,13 +230,6 @@ class BaseModule:
     def output_names(self):
         raise NotImplementedError()
 
-    @property
-    def symbol(self):
-        return self._symbol
-
-    # ------------------------------------------------------------------
-    # Input/Output information
-    # ------------------------------------------------------------------
     @property
     def data_shapes(self):
         raise NotImplementedError()
@@ -214,9 +242,6 @@ class BaseModule:
     def output_shapes(self):
         raise NotImplementedError()
 
-    # ------------------------------------------------------------------
-    # Parameters of a module
-    # ------------------------------------------------------------------
     def get_params(self):
         raise NotImplementedError()
 
@@ -224,36 +249,6 @@ class BaseModule:
                     allow_missing=False, force_init=False):
         raise NotImplementedError()
 
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True):
-        self.init_params(initializer=None, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
-
-    def save_params(self, fname):
-        arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v.as_in_context(nd.cpu()) if False else v
-                     for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
-
-    def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
-
-    # ------------------------------------------------------------------
-    # Computations
-    # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         raise NotImplementedError()
 
@@ -272,9 +267,6 @@ class BaseModule:
     def update_metric(self, eval_metric, labels):
         raise NotImplementedError()
 
-    # ------------------------------------------------------------------
-    # module setup
-    # ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):

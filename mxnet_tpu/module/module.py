@@ -36,6 +36,7 @@ class Module(BaseModule):
         self._step_stale = False          # executor arrays newer than step
         self._exec_stale = False          # step newer than executor arrays
         self._opt_owner = "eager"         # who holds live optimizer slots
+        self._monitor = None
         if context is None:
             context = ctx_mod.cpu()
         if isinstance(context, ctx_mod.Context):
@@ -287,6 +288,11 @@ class Module(BaseModule):
 
         self._optimizer = optimizer
         self._kvstore = kvstore
+        # when the fused step will own the update, the optimizer must NOT
+        # also live in the kvstore — keep a local updater as the eager
+        # fallback so state handoffs have somewhere to go
+        if update_on_kvstore and self._fused_eligible(optimizer, kvstore):
+            update_on_kvstore = False
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
 
@@ -307,26 +313,35 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
-    def _maybe_build_fused_step(self):
-        """Compile forward+backward+optimizer into one donated XLA program
-        when the configuration allows it (single-process kvstore, optimizer
-        with a fused kernel, grad_req=write)."""
+    def _fused_eligible(self, optimizer, kvstore):
+        """Whether the fused (donated, jitted) train step can own the
+        update: single-process kvstore, no monitor taps, optimizer with a
+        fused kernel, no data grads requested."""
         from .. import config as _config
 
-        self._flush_fused()  # re-init must not revert trained weights
-        self._fused_step = None
         if not _config.get("MXNET_FUSED_TRAIN_STEP"):
-            return
-        if not self.for_training:
-            return
-        if self._kvstore is not None and self._kvstore.type.startswith("dist"):
-            return  # cross-process reduction rides the kvstore path
-        if self.inputs_need_grad:
-            return  # caller wants data grads materialized
-        if self._optimizer.fused_kernel() is None:
+            return False
+        if _config.get("MXNET_ENGINE_TYPE") == "NaiveEngine":
+            return False  # debugging mode: eager per-op execution
+        if not self.for_training or self.inputs_need_grad:
+            return False
+        if self._monitor is not None:
+            return False  # per-op taps need the eager executor path
+        if kvstore is not None and kvstore.type.startswith("dist"):
+            return False  # cross-process reduction rides the kvstore path
+        if optimizer.fused_kernel() is None:
             self.logger.info(
                 "optimizer %s has no fused kernel; using eager update path",
-                type(self._optimizer).__name__)
+                type(optimizer).__name__)
+            return False
+        return True
+
+    def _maybe_build_fused_step(self):
+        """Compile forward+backward+optimizer into one donated XLA program
+        when the configuration allows it."""
+        self._flush_fused()  # re-init must not revert trained weights
+        self._fused_step = None
+        if not self._fused_eligible(self._optimizer, self._kvstore):
             return
         from ..train_step import CompiledTrainStep
 
@@ -498,6 +513,7 @@ class Module(BaseModule):
         """Per-op output taps require the interpreted executor path, so a
         monitored module drops back to eager forward/backward/update."""
         assert self.binded
+        self._monitor = mon
         if self._fused_step is not None:
             self._handoff_fused_to_eager()
             self._fused_step = None

@@ -1,85 +1,96 @@
 """Monitor — per-op output statistics for debugging (NaN hunting).
 
-Reference: `python/mxnet/monitor.py:16-100` taps every op output via the
-executor monitor callback (`graph_executor.cc:758-778`).  Here installing a
-monitor flips the executor into its eager (NaiveEngine-analog) node-by-node
-path so intermediate values exist to be observed.
+Same role as the reference's ``python/mxnet/monitor.py`` over the executor
+monitor callback (`graph_executor.cc:758-778`): every op output (plus,
+between tic/toc, every argument array) is reduced by a statistic function
+and collected for printing.  Installing a monitor flips the executor into
+its eager node-by-node path (the NaiveEngine analog) so intermediates exist
+to observe — see Executor.forward.
+
+Re-designed around plain records: statistics are materialized to host
+floats/arrays at collection time, and formatting is a separate step.
 """
 from __future__ import annotations
 
 import logging
 import re
 
+import numpy as np
+
 from . import ndarray as nd
-from .ndarray import NDArray
+
+
+def _default_stat(x):
+    """Mean absolute value — cheap, scale-aware, NaN-propagating."""
+    return nd.norm(x) / (x.size ** 0.5)
 
 
 class Monitor:
+    """Collects ``(step, name, stat)`` records during monitored batches.
+
+    Parameters mirror the reference: ``interval`` (batches between
+    collections), ``stat_func`` (NDArray -> NDArray statistic), ``pattern``
+    (regex over tensor names), ``sort`` (order records by name in toc).
+    """
+
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return nd.norm(x) / (x.size ** 0.5)
-
-            stat_func = asum_stat
-        self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.stat_func = stat_func or _default_stat
         self.sort = sort
+        self._matches = re.compile(pattern).match
+        self._records = []
+        self._step = 0
+        self._collecting = False
+        self._executors = []
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
-
-        self.stat_helper = stat_helper
-
+    # -- executor hookup ---------------------------------------------------
     def install(self, exe):
-        exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        """Register this monitor's tap with an executor."""
+        exe.set_monitor_callback(self._observe)
+        self._executors.append(exe)
 
+    def _observe(self, name, array):
+        if self._collecting and self._matches(name):
+            self._records.append((self._step, name, self.stat_func(array)))
+
+    # -- batch lifecycle ---------------------------------------------------
     def tic(self):
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Call before a batch; arms collection every ``interval`` steps."""
+        if self._step % self.interval == 0:
+            self._records = []
+            self._collecting = True
+        self._step += 1
 
     def toc(self):
-        if not self.activated:
+        """Call after the batch; returns [(step, name, rendered_stat)] and
+        disarms.  Also samples every matching argument array (weights), so
+        exploding params are visible alongside activations."""
+        if not self._collecting:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in zip(exe._symbol.list_arguments(), exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,):
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
-        self.queue = []
-        return res
+        for exe in self._executors:
+            for name, arr in zip(exe._symbol.list_arguments(),
+                                 exe.arg_arrays):
+                if self._matches(name):
+                    self._records.append(
+                        (self._step, name, self.stat_func(arr)))
+        self._collecting = False
+
+        records = sorted(self._records, key=lambda r: r[1]) if self.sort \
+            else list(self._records)
+        self._records = []
+        return [(step, name, self._render(stat))
+                for step, name, stat in records]
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: {:7d} {:30s} {:s}".format(n, k, v))
+        """toc() + log each record."""
+        for step, name, rendered in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, rendered)
+
+    @staticmethod
+    def _render(stat):
+        values = stat if isinstance(stat, list) else [stat]
+        parts = []
+        for v in values:
+            host = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+            parts.append(str(host.item()) if host.size == 1 else str(host))
+        return "\t".join(parts)

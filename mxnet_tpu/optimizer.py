@@ -147,18 +147,30 @@ class Optimizer:
         """
         return None
 
-    def fused_hyper(self, names):
+    def fused_hyper(self, indices):
         """Host-side per-step hyperparams for the fused step: bumps update
-        counts exactly as the eager path does and returns
+        counts exactly as the eager path does (same integer index keys, so
+        fused<->eager handoffs see one consistent count) and returns
         ``(lrs, wds, rescale, clip)`` numpy arrays/scalars, one lr/wd per
-        name in ``names``."""
-        for name in names:
-            self._update_count(name)
-        lrs = np.array([self._get_lr(n) for n in names], np.float32)
-        wds = np.array([self._get_wd(n) for n in names], np.float32)
+        entry in ``indices``."""
+        for idx in indices:
+            self._update_count(idx)
+        lrs = np.array([self._get_lr(i) for i in indices], np.float32)
+        wds = np.array([self._get_wd(i) for i in indices], np.float32)
         clip = np.float32(self.clip_gradient
                           if self.clip_gradient is not None else -1.0)
         return lrs, wds, np.float32(self.rescale_grad), clip
+
+    def pack_state(self, arrays):
+        """Assemble a ``create_state``-shaped value from a flat list of
+        state arrays — the inverse of flattening into fused slots.  The
+        default maps 0 -> None, 1 -> bare array, n -> tuple; optimizers
+        whose create_state is a 1-tuple (RMSProp) override this."""
+        if not arrays:
+            return None
+        if len(arrays) == 1:
+            return arrays[0]
+        return tuple(arrays)
 
 
 register = Optimizer.register
@@ -391,11 +403,11 @@ class Adam(Optimizer):
 
         return make_slots, apply
 
-    def fused_hyper(self, names):
-        lrs, wds, rescale, clip = super().fused_hyper(names)
+    def fused_hyper(self, indices):
+        lrs, wds, rescale, clip = super().fused_hyper(indices)
         # fold the bias correction into lr host-side, as eager update() does
-        for i, name in enumerate(names):
-            t = self._index_update_count[name]
+        for i, idx in enumerate(indices):
+            t = self._index_update_count[idx]
             lrs[i] *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         return lrs, wds, rescale, clip
 
@@ -468,6 +480,10 @@ class RMSProp(Optimizer):
         self.centered = centered
         self.epsilon = epsilon
         self.clip_weights = clip_weights
+
+    def pack_state(self, arrays):
+        # create_state is a tuple even in the single-slot (uncentered) case
+        return tuple(arrays)
 
     def create_state(self, index, weight):
         if self.centered:
@@ -639,9 +655,8 @@ class Updater:
                     isinstance(s, np.ndarray) for s in state):
                 import jax.numpy as jnp
 
-                arrays = [NDArray(jnp.asarray(s)) for s in state]
-                state = (None if not arrays else
-                         arrays[0] if len(arrays) == 1 else tuple(arrays))
+                state = self.optimizer.pack_state(
+                    [NDArray(jnp.asarray(s)) for s in state])
             converted[idx] = state
         self.states = converted
 

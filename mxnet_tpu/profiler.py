@@ -59,6 +59,10 @@ def stop():
     profiler_set_state("stop")
 
 
+def is_running():
+    return _state["running"]
+
+
 class Scope:
     """Record one named span into the chrome trace (engine OprExecStat analog)."""
 
@@ -87,3 +91,10 @@ def dump_profile():
         payload = {"traceEvents": list(_state["events"]), "displayTimeUnit": "ms"}
         with open(_state["filename"], "w") as f:
             json.dump(payload, f)
+
+
+# reference env_var.md:71-79 — start profiling at library load
+from . import config as _config
+
+if _config.get("MXNET_PROFILER_AUTOSTART"):
+    start()

@@ -257,4 +257,10 @@ def invoke(opdef, inputs, attrs=None, is_train=False, rng=None, aux=()):
         # per-call PRNGKey device allocation
         rng = _dummy_key()
     fn = _jitted(opdef, attrs, bool(is_train), len(aux), opdef.needs_rng)
+    from . import profiler as _prof
+
+    if _prof.is_running():
+        # per-op dispatch span, the engine OprExecStat analog
+        with _prof.Scope(opdef.name, "imperative"):
+            return fn(list(inputs), list(aux), rng)
     return fn(list(inputs), list(aux), rng)

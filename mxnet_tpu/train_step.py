@@ -61,6 +61,10 @@ class CompiledTrainStep:
             raise MXNetError("fused train step supports grad_req "
                              "null/write only; got add for %s" % unsupported)
         self._aux_names = list(exe._aux_names)
+        # optimizer bookkeeping (update counts, lr_mult) is keyed by the
+        # param's index in the executor group, matching the eager path
+        self._grad_indices = [exec_group.param_names.index(n)
+                              for n in self._grad_names]
 
         if compute_dtype in (None, "", "float32", np.float32):
             self._cdtype = None
@@ -139,7 +143,7 @@ class CompiledTrainStep:
             for name, arr in zip(self._label_names, data_batch.label):
                 data[name] = self._place(arr, name)
 
-        lrs, wds, rescale, clip = self._optimizer.fused_hyper(self._grad_names)
+        lrs, wds, rescale, clip = self._optimizer.fused_hyper(self._grad_indices)
         rng = _rnd.split_key()
         self.params, self.slots, self.aux, outs = self._fn(
             self.params, self.slots, self.aux, data, lrs, wds, rescale, clip,
@@ -239,12 +243,6 @@ class CompiledTrainStep:
         for idx, name in enumerate(param_names):
             if name not in self.slots:
                 continue
-            slots = self.slots[name]
-            arrays = [_nd.NDArray(jnp.copy(s), ctx) for s in slots]
-            if not arrays:
-                state = None
-            elif len(arrays) == 1:
-                state = arrays[0]
-            else:
-                state = tuple(arrays)
-            updater.states[idx] = state
+            arrays = [_nd.NDArray(jnp.copy(s), ctx)
+                      for s in self.slots[name]]
+            updater.states[idx] = self._optimizer.pack_state(arrays)

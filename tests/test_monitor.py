@@ -1,0 +1,78 @@
+"""Monitor taps every op output (reference: graph_executor.cc:758-778,
+python/mxnet/monitor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.io import DataBatch
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = mx.sym.Activation(fc1, name="act1", act_type="tanh")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=3)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _module():
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))], label_shapes=[("softmax_label", (4,))])
+    mx.random.seed(0)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer()
+    return mod
+
+
+def _batch():
+    rng = np.random.RandomState(1)
+    return DataBatch([nd.array(rng.uniform(-1, 1, (4, 6)).astype(np.float32))],
+                     [nd.array(rng.randint(0, 3, (4,)).astype(np.float32))])
+
+
+def test_monitor_taps_internal_ops():
+    mod = _module()
+    mon = mx.monitor.Monitor(interval=1)
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward_backward(_batch())
+    mod.update()
+    names = {name for _, name, _ in mon.toc()}
+    # intermediate op outputs, not just the head
+    assert "fc1_output" in names
+    assert "act1_output" in names
+    assert "softmax_output" in names
+    # argument (weight) arrays are sampled too
+    assert "fc1_weight" in names
+
+
+def test_monitor_catches_midgraph_nan():
+    mod = _module()
+    # poison an internal weight: NaN appears at fc2_output, before the head
+    args, auxs = mod.get_params()
+    bad = np.array(args["fc2_weight"].asnumpy())
+    bad[0, 0] = np.nan
+    args["fc2_weight"] = nd.array(bad)
+    mod.set_params(args, auxs)
+
+    mon = mx.monitor.Monitor(interval=1, pattern=".*output")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(_batch(), is_train=False)
+    records = {name: rendered for _, name, rendered in mon.toc()}
+    assert "nan" in records["fc2_output"].lower()
+    # the upstream activation is clean — the monitor localizes the NaN
+    assert "nan" not in records["act1_output"].lower()
+
+
+def test_monitor_interval_gates_collection():
+    mod = _module()
+    mon = mx.monitor.Monitor(interval=2)
+    mod.install_monitor(mon)
+    collected = []
+    for _ in range(4):
+        mon.tic()
+        mod.forward(_batch(), is_train=False)
+        collected.append(len(mon.toc()))
+    assert collected[0] > 0 and collected[2] > 0
+    assert collected[1] == 0 and collected[3] == 0
