@@ -1,15 +1,20 @@
 """BucketingModule — variable-length (bucketed) training.
 
-Reference: `python/mxnet/module/bucketing_module.py` (18-120).  Buckets are
-load-bearing on XLA exactly as in the reference (SURVEY §7c): each bucket
-key is a separate shape specialization; modules share parameters via
-shared-module binding, and jit caches one compiled program per bucket.
+Capability parity with the reference's ``module/bucketing_module.py``.
+Buckets are load-bearing on XLA exactly as in the reference (SURVEY §7c):
+every bucket key is a shape specialization with its own compiled program,
+while parameters live in ONE set of buffers shared through shared-module
+binding.
+
+Layout here: a ``_primary`` module (default bucket) owns params and the
+optimizer; ``_bucket_for`` lazily binds per-key modules against it.  All
+buckets run the eager update path — a per-bucket fused step would fork the
+master weights (see Module.borrow_optimizer).
 """
 from __future__ import annotations
 
 import logging
 
-from ..base import MXNetError
 from .base_module import BaseModule
 from .module import Module
 
@@ -19,63 +24,74 @@ class BucketingModule(BaseModule):
                  context=None, work_load_list=None, fixed_param_names=None):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
-        self._default_bucket_key = default_bucket_key
         self._sym_gen = sym_gen
-        self._context = context
-        self._work_load_list = work_load_list
-        self._fixed_param_names = fixed_param_names
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+        self._default_bucket_key = default_bucket_key
+        self._module_kwargs = dict(
+            logger=logger, context=context, work_load_list=work_load_list,
+            fixed_param_names=fixed_param_names)
+        self._clear()
+
+    def _clear(self):
+        self._by_key = {}
+        self._active = None
+        self._active_key = None
         self._params_dirty = False
 
-    def _reset_bind(self):
-        self.binded = False
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+    @property
+    def _primary(self):
+        return self._by_key.get(self._default_bucket_key)
 
     @property
+    def _curr_module(self):
+        # reference-compatible accessor (tests and user code reach for it)
+        return self._active
+
+    @property
+    def _buckets(self):
+        return self._by_key
+
+    def _new_module(self, bucket_key):
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names, label_names, **self._module_kwargs)
+
+    # ------------------------------------------------------------------
+    @property
     def data_names(self):
-        if self.binded:
-            return self._curr_module.data_names
-        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
-        return data_names
+        if self._active is not None:
+            return self._active.data_names
+        return self._sym_gen(self._default_bucket_key)[1]
 
     @property
     def output_names(self):
-        if self.binded:
-            return self._curr_module.output_names
-        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
-        return symbol.list_outputs()
+        if self._active is not None:
+            return self._active.output_names
+        return self._sym_gen(self._default_bucket_key)[0].list_outputs()
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._curr_module.data_shapes
+        return self._active.data_shapes
 
     @property
     def label_shapes(self):
         assert self.binded
-        return self._curr_module.label_shapes
+        return self._active.label_shapes
 
     @property
     def output_shapes(self):
         assert self.binded
-        return self._curr_module.output_shapes
+        return self._active.output_shapes
 
     @property
     def symbol(self):
         assert self.binded
-        return self._curr_module.symbol
+        return self._active.symbol
 
-    def _call_sym_gen(self, bucket_key):
-        return self._sym_gen(bucket_key)
-
+    # ------------------------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        self._curr_module._params_dirty = self._params_dirty
-        params = self._curr_module.get_params()
+        self._active._params_dirty = self._params_dirty
+        params = self._active.get_params()
         self._params_dirty = False
         return params
 
@@ -84,62 +100,57 @@ class BucketingModule(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
-        self._curr_module.init_params(initializer=initializer,
-                                      arg_params=arg_params,
-                                      aux_params=aux_params,
-                                      allow_missing=allow_missing,
-                                      force_init=force_init)
+        self._active.init_params(initializer=initializer,
+                                 arg_params=arg_params, aux_params=aux_params,
+                                 allow_missing=allow_missing,
+                                 force_init=force_init)
         self._params_dirty = False
         self.params_initialized = True
 
+    # ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        if self.params_initialized:
-            arg_params, aux_params = self.get_params()
+        assert shared_module is None, \
+            "shared_module for BucketingModule is not supported"
+        snapshot = self.get_params() if self.params_initialized else None
         if force_rebind:
-            self._reset_bind()
+            self._clear()
+            self.binded = False
         if self.binded:
             self.logger.warning("Already binded, ignoring bind()")
             return
-        assert shared_module is None, \
-            "shared_module for BucketingModule is not supported"
 
+        self.binded = True
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self.binded = True
 
-        symbol, data_names, label_names = self._call_sym_gen(self._default_bucket_key)
-        module = Module(symbol, data_names, label_names, logger=self.logger,
-                        context=self._context, work_load_list=self._work_load_list,
-                        fixed_param_names=self._fixed_param_names)
-        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
-                    force_rebind=False, shared_module=None, grad_req=grad_req)
-        self._curr_module = module
-        self._curr_bucket_key = self._default_bucket_key
-        self._buckets[self._default_bucket_key] = module
+        primary = self._new_module(self._default_bucket_key)
+        primary.bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, grad_req=grad_req)
+        self._by_key = {self._default_bucket_key: primary}
+        self._active = primary
+        self._active_key = self._default_bucket_key
 
-        if self.params_initialized:
-            self.set_params(arg_params, aux_params)
+        if snapshot is not None:
+            self.set_params(*snapshot)
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
-        """Switch to a bucket, binding it if needed (reference: :158)."""
+        """Make ``bucket_key`` the active specialization, binding a new
+        module against the primary's buffers on first use."""
         assert self.binded, "call bind before switching bucket"
-        if bucket_key not in self._buckets:
-            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
-            module = Module(symbol, data_names, label_names, logger=self.logger,
-                            context=self._context,
-                            work_load_list=self._work_load_list,
-                            fixed_param_names=self._fixed_param_names)
-            module.bind(data_shapes, label_shapes, self._curr_module.for_training,
-                        self._curr_module.inputs_need_grad,
-                        force_rebind=False,
-                        shared_module=self._buckets[self._default_bucket_key])
+        module = self._by_key.get(bucket_key)
+        if module is None:
+            module = self._new_module(bucket_key)
+            module.bind(data_shapes, label_shapes,
+                        self._primary.for_training,
+                        self._primary.inputs_need_grad,
+                        shared_module=self._primary)
             if self.optimizer_initialized:
-                module.borrow_optimizer(self._buckets[self._default_bucket_key])
-            self._buckets[bucket_key] = module
-        self._curr_module = self._buckets[bucket_key]
-        self._curr_bucket_key = bucket_key
+                module.borrow_optimizer(self._primary)
+            self._by_key[bucket_key] = module
+        self._active = module
+        self._active_key = bucket_key
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -148,41 +159,49 @@ class BucketingModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
-                                         force_init=force_init)
-        for mod in self._buckets.values():
-            if mod is not self._curr_module:
-                mod.borrow_optimizer(self._curr_module)
+        primary = self._primary
+        primary.init_optimizer(kvstore, optimizer, optimizer_params,
+                               force_init=force_init)
+        if primary._fused_step is not None:
+            # all buckets must share one update path; a fused step on the
+            # primary alone would fork the weights away from the shared
+            # executor buffers the other buckets read
+            primary._handoff_fused_to_eager()
+            primary._fused_step = None
+        for module in self._by_key.values():
+            if module is not primary:
+                module.borrow_optimizer(primary)
         self.optimizer_initialized = True
 
+    # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
-        self._curr_module.forward(data_batch, is_train=is_train)
+        self._active.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        self._curr_module.backward(out_grads=out_grads)
+        self._active.backward(out_grads=out_grads)
 
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
-        self._curr_module.update()
+        self._active.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._curr_module.get_outputs(merge_multi_context)
+        return self._active.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._curr_module.get_input_grads(merge_multi_context)
+        return self._active.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        self._curr_module.update_metric(eval_metric, labels)
+        self._active.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for mod in self._buckets.values():
-            mod.install_monitor(mon)
+        for module in self._by_key.values():
+            module.install_monitor(mon)

@@ -1,10 +1,26 @@
-"""SequentialModule — chain of modules (reference: module/sequential_module.py)."""
+"""SequentialModule — a pipeline of modules executed back-to-back.
+
+API parity with the reference's ``module/sequential_module.py`` (``add``
+with ``take_labels``/``auto_wiring`` metadata, BaseModule surface), built
+around an explicit ``_Stage`` record per child and one shape-chaining
+helper instead of inline meta-dict plumbing.
+"""
 from __future__ import annotations
 
 import logging
 
-from ..base import MXNetError
 from .base_module import BaseModule
+
+
+class _Stage:
+    """One link of the chain: a module plus its wiring flags."""
+
+    __slots__ = ("module", "takes_labels", "auto_wire")
+
+    def __init__(self, module, takes_labels=False, auto_wire=False):
+        self.module = module
+        self.takes_labels = takes_labels
+        self.auto_wire = auto_wire
 
 
 class SequentialModule(BaseModule):
@@ -13,40 +29,40 @@ class SequentialModule(BaseModule):
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages = []
         self._label_shapes = None
         self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
 
     def add(self, module, **kwargs):
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta %s" % key
-        self._metas.append(kwargs)
+        unknown = set(kwargs) - {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        assert not unknown, "Unknown meta %s" % sorted(unknown)
+        self._stages.append(_Stage(
+            module,
+            takes_labels=bool(kwargs.get(self.META_TAKE_LABELS)),
+            auto_wire=bool(kwargs.get(self.META_AUTO_WIRING))))
+        # adding a layer invalidates any previous setup
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
     @property
+    def _modules(self):
+        return [s.module for s in self._stages]
+
+    # ------------------------------------------------------------------
+    @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
@@ -56,44 +72,45 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
 
+    # ------------------------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for stage in self._stages:
+            a, x = stage.module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        for module in self._modules:
-            module.init_params(initializer=initializer, arg_params=arg_params,
-                               aux_params=aux_params, allow_missing=allow_missing,
-                               force_init=force_init)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, "Duplicated parameter names: " + \
-                    ("name %s in layer %d (%s) is already used in layer %d (%s)."
-                     % (name, i, type(modules[i]), known_names[name],
-                        type(modules[known_names[name]])))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params_, aux_params_ = module.get_params()
-            _check_name(arg_names, arg_params_.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params_.keys(), self._modules, i_layer)
+        for stage in self._stages:
+            stage.module.init_params(
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_init=force_init)
+        self._assert_unique_params()
         self.params_initialized = True
 
+    def _assert_unique_params(self):
+        owners = {}
+        for i, stage in enumerate(self._stages):
+            for group in stage.module.get_params():
+                for name in group:
+                    if name in owners:
+                        raise AssertionError(
+                            "Duplicated parameter name %s: layer %d (%s) and "
+                            "layer %d (%s)" % (
+                                name, i, type(stage.module),
+                                owners[name], type(self._modules[owners[name]])))
+                    owners[name] = i
+
+    # ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -103,53 +120,39 @@ class SequentialModule(BaseModule):
         if inputs_need_grad:
             assert for_training
         assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty SequentialModule"
+        assert self._stages, "Attempting to bind an empty SequentialModule"
 
         self.binded = True
-        self._label_shapes = label_shapes
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
         self._data_shapes = data_shapes
+        self._label_shapes = label_shapes if any(
+            s.takes_labels for s in self._stages) else None
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-
-            my_inputs_need_grad = bool(for_training and
-                                       (inputs_need_grad or i_layer > 0))
-
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape[1]) for new_name, shape in
-                                  zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes, label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            # the output of the previous module is the data of the next
-            module_outputs_names = module.output_names
-            my_data_shapes = [(name, tuple(shape)) for name, shape in
-                              zip(module_outputs_names,
-                                  [s[1] for s in self._infer_module_output_shapes(
-                                      module, my_data_shapes)])]
-
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+        shapes = list(data_shapes)
+        for i, stage in enumerate(self._stages):
+            if stage.auto_wire:
+                # adopt the child's own input names for the incoming shapes
+                names = stage.module.data_names
+                assert len(names) == len(shapes)
+                shapes = [(n, s[1]) for n, s in zip(names, shapes)]
+            stage.module.bind(
+                data_shapes=shapes,
+                label_shapes=label_shapes if stage.takes_labels else None,
+                for_training=for_training,
+                inputs_need_grad=bool(for_training and
+                                      (inputs_need_grad or i > 0)),
+                force_rebind=force_rebind, grad_req=grad_req)
+            shapes = self._outgoing_shapes(stage.module, shapes)
 
     @staticmethod
-    def _infer_module_output_shapes(module, data_shapes):
+    def _outgoing_shapes(module, incoming):
+        """Output (name, shape) pairs of a bound child, which become the
+        next child's data shapes."""
         _, out_shapes, _ = module.symbol.infer_shape(
-            **{name: shape for name, shape in data_shapes})
-        return [(name, shape) for name, shape in
-                zip(module.output_names, out_shapes)]
+            **{name: shape for name, shape in incoming})
+        return [(name, tuple(shape))
+                for name, shape in zip(module.output_names, out_shapes)]
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -158,57 +161,54 @@ class SequentialModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for stage in self._stages:
+            stage.module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                        optimizer_params=optimizer_params,
+                                        force_init=force_init)
         self.optimizer_initialized = True
 
+    # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         from ..io import DataBatch
 
         batch = data_batch
-        for i_layer, module in enumerate(self._modules):
-            module.forward(batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        for stage, nxt in zip(self._stages, self._stages[1:] + [None]):
+            stage.module.forward(batch, is_train=is_train)
+            if nxt is None:
                 break
-            data = module.get_outputs()
-            label = batch.label if SequentialModule.META_TAKE_LABELS in \
-                self._metas[i_layer + 1] else None
-            batch = DataBatch(data=data, label=label, pad=data_batch.pad,
-                              index=data_batch.index)
+            batch = DataBatch(
+                data=stage.module.get_outputs(),
+                label=data_batch.label if nxt.takes_labels else None,
+                pad=data_batch.pad, index=data_batch.index)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(range(len(self._modules)),
-                                                 self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        for i in range(len(self._stages) - 1, -1, -1):
+            self._stages[i].module.backward(out_grads=out_grads)
+            if i:
+                out_grads = self._stages[i].module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        for stage in self._stages:
+            stage.module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1].module.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        return self._stages[0].module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for stage in self._stages:
+            if stage.takes_labels:
+                stage.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for stage in self._stages:
+            stage.module.install_monitor(mon)
