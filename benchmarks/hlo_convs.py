@@ -1,0 +1,69 @@
+"""Dump the forward graph's HLO convolutions with shapes + estimated flops."""
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.models import resnet
+
+BATCH = 256
+
+
+def main():
+    ctx = mx.tpu() if jax.devices()[0].platform != "cpu" else mx.cpu()
+    net = resnet.get_symbol(1000, 50, (3, 224, 224))
+    mod = mx.mod.Module(net, context=ctx, compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    step = mod._fused_step
+    exe = step._exec
+    cdtype = jnp.bfloat16
+    params = {n: (v.astype(cdtype)
+                  if jnp.issubdtype(v.dtype, jnp.floating) else v)
+              for n, v in step.params.items()}
+    aux = dict(step.aux)
+    x = jnp.zeros((BATCH, 3, 224, 224), cdtype)
+    y = jnp.zeros((BATCH,), jnp.float32)
+    data = {"data": x, "softmax_label": y}
+    key = jax.random.PRNGKey(0)
+
+    def fwd_only(params, data, aux):
+        env = dict(params)
+        env.update(data)
+        outs, _ = exe._run_graph(env, aux, key, True)
+        return outs
+
+    hlo = jax.jit(fwd_only).lower(params, data, aux).compile().as_text()
+    total = 0
+    n = 0
+    for line in hlo.splitlines():
+        if "convolution(" not in line and "convolution-base-dilated" not in line \
+                and " = convolution" not in line.replace("fusion", ""):
+            continue
+        m = re.search(r"(\w+\[[\d,]+\][^=]*)= convolution", line)
+        if not m:
+            continue
+        out = re.search(r"\[([\d,]+)\]", line)
+        shapes = re.findall(r"\[([\d,]+)\]", line)
+        # out shape, lhs shape, rhs shape
+        dims = re.search(r"dim_labels=(\S+)", line)
+        window = re.search(r"window={(.*?)}", line)
+        print("conv%-3d out=%s lhs=%s rhs=%s %s %s"
+              % (n, shapes[0], shapes[1] if len(shapes) > 1 else "?",
+                 shapes[2] if len(shapes) > 2 else "?",
+                 dims.group(1) if dims else "",
+                 (window.group(1)[:40] if window else "")))
+        n += 1
+    print("total convolution instructions:", n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Probe: conv layout strategies on the TPU chip.
+
+Times fwd+bwd of a ResNet-50-ish conv/BN/relu stack under three layouts:
+  nchw      - lax.conv with NCHW/OIHW dims (current ops/nn.py behavior)
+  nhwc_wrap - NCHW graph, each conv locally transposes to NHWC and back
+  nhwc_full - whole stack natively NHWC/HWIO
+
+Run on the bench chip to decide how ops/nn.py should lay out convs.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# (in_ch, out_ch, spatial, stride, n_blocks) rough resnet50 stage shapes
+STAGES = [
+    (64, 64, 56, 1, 3),
+    (256, 128, 28, 2, 4),
+    (512, 256, 14, 2, 6),
+    (1024, 512, 7, 2, 3),
+]
+BATCH = 256
+DTYPE = jnp.bfloat16
+
+
+def make_params(mode, key):
+    params = []
+    prev = STAGES[0][0]
+    for (cin, cout, sp, st, nb) in STAGES:
+        for b in range(nb):
+            ci = prev
+            prev = cout
+            if mode == "nhwc_full":
+                w = jax.random.normal(key, (3, 3, ci, cout), DTYPE) * 0.05
+            else:
+                w = jax.random.normal(key, (cout, ci, 3, 3), DTYPE) * 0.05
+            gamma = jnp.ones((cout,), jnp.float32)
+            beta = jnp.zeros((cout,), jnp.float32)
+            params.append((w, gamma, beta))
+    return params
+
+
+def bn(x, gamma, beta, caxis):
+    red = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = tuple(x.shape[caxis] if i == caxis else 1 for i in range(x.ndim))
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=red)
+    var = jnp.var(x32, axis=red)
+    inv = lax.rsqrt(var.reshape(bshape) + 1e-5)
+    out = (x32 - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+    return out.astype(x.dtype)
+
+
+def stack(mode, params, x):
+    i = 0
+    for (cin, cout, sp, st, nb) in STAGES:
+        for b in range(nb):
+            w, gamma, beta = params[i]
+            i += 1
+            stride = (st, st) if b == 0 else (1, 1)
+            if mode == "nchw":
+                x = lax.conv_general_dilated(
+                    x, w, stride, ((1, 1), (1, 1)),
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                x = bn(x, gamma, beta, 1)
+            elif mode == "nhwc_wrap":
+                xt = jnp.transpose(x, (0, 2, 3, 1))
+                wt = jnp.transpose(w, (2, 3, 1, 0))
+                xt = lax.conv_general_dilated(
+                    xt, wt, stride, ((1, 1), (1, 1)),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                x = jnp.transpose(xt, (0, 3, 1, 2))
+                x = bn(x, gamma, beta, 1)
+            else:  # nhwc_full
+                x = lax.conv_general_dilated(
+                    x, w, stride, ((1, 1), (1, 1)),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                x = bn(x, gamma, beta, 3)
+            x = jnp.maximum(x, 0)
+    return x
+
+
+def loss_fn(mode, params, x):
+    out = stack(mode, params, x)
+    return jnp.sum(out.astype(jnp.float32))
+
+
+def bench(mode, iters=10):
+    key = jax.random.PRNGKey(0)
+    params = make_params(mode, key)
+    if mode == "nhwc_full":
+        x = jax.random.normal(key, (BATCH, 56, 56, 64), DTYPE)
+    else:
+        x = jax.random.normal(key, (BATCH, 64, 56, 56), DTYPE)
+
+    grad = jax.jit(jax.grad(functools.partial(loss_fn, mode), argnums=0))
+
+    def fence(g):
+        # tunneled platform: block_until_ready returns early; a value fetch
+        # is the only reliable sync
+        return float(jnp.sum(g[0][0].astype(jnp.float32)))
+
+    g = grad(params, x)
+    fence(g)
+    tic = time.time()
+    for _ in range(iters):
+        g = grad(params, x)
+    fence(g)
+    dt = (time.time() - tic) / iters
+    print("%-10s %7.2f ms/step  %7.1f img/s" % (mode, dt * 1e3, BATCH / dt))
+    return dt
+
+
+if __name__ == "__main__":
+    print("device:", jax.devices()[0].device_kind)
+    for mode in ("nchw", "nhwc_wrap", "nhwc_full"):
+        bench(mode)

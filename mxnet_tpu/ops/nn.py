@@ -321,33 +321,91 @@ def register_all():
         Param("use_global_stats", bool, default=False),
         Param("output_mean_var", bool, default=False))
 
+    def _bn_train_core(eps, caxis):
+        """Training-mode BN as an explicit custom_vjp.
+
+        The autodiff-derived backward of the naive formulation saves the
+        float32-upcast activation as a residual — at bf16 compute that
+        doubles BN's HBM traffic, and this op is memory-bound.  Here the
+        residuals are the *compute-dtype* input plus the (C,)-sized fp32
+        statistics; both passes do elementwise math in the compute dtype
+        with only the channel reductions in fp32.
+        """
+
+        def stats(x):
+            red = tuple(i for i in range(x.ndim) if i != caxis)
+            x32 = x.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=red)
+            var = jnp.var(x32, axis=red)
+            return mean, var
+
+        def apply(x, gamma, beta, mean, inv):
+            bshape = tuple(x.shape[caxis] if i == caxis else 1
+                           for i in range(x.ndim))
+            scale = (inv * gamma.astype(jnp.float32)).astype(x.dtype)
+            shift = (beta.astype(jnp.float32)
+                     - mean * inv * gamma.astype(jnp.float32)).astype(x.dtype)
+            return x * scale.reshape(bshape) + shift.reshape(bshape)
+
+        @jax.custom_vjp
+        def bn(x, gamma, beta):
+            mean, var = stats(x)
+            inv = jax.lax.rsqrt(var + eps)
+            return apply(x, gamma, beta, mean, inv), mean, var
+
+        def bn_fwd(x, gamma, beta):
+            mean, var = stats(x)
+            inv = jax.lax.rsqrt(var + eps)
+            return (apply(x, gamma, beta, mean, inv), mean, var), \
+                (x, gamma, mean, inv)
+
+        def bn_bwd(res, cts):
+            x, gamma, mean, inv = res
+            dy = cts[0]  # mean/var outputs feed stop_gradient'd aux updates
+            red = tuple(i for i in range(x.ndim) if i != caxis)
+            bshape = tuple(x.shape[caxis] if i == caxis else 1
+                           for i in range(x.ndim))
+            n = 1
+            for i in red:
+                n *= x.shape[i]
+            xhat = (x.astype(jnp.float32) - mean.reshape(bshape)) \
+                * inv.reshape(bshape)
+            dy32 = dy.astype(jnp.float32)
+            dbeta = jnp.sum(dy32, axis=red)
+            dgamma = jnp.sum(dy32 * xhat, axis=red)
+            g32 = gamma.astype(jnp.float32)
+            dx = (inv * g32).reshape(bshape) \
+                * (dy32 - (dbeta / n).reshape(bshape)
+                   - xhat * (dgamma / n).reshape(bshape))
+            return dx.astype(x.dtype), dgamma.astype(gamma.dtype), \
+                dbeta.astype(gamma.dtype)
+
+        bn.defvjp(bn_fwd, bn_bwd)
+        return bn
+
     def _batchnorm(attrs, inputs, aux, octx):
         data, gamma, beta = inputs
         moving_mean, moving_var = aux
         eps = attrs.get("eps", 1e-3)
         momentum = attrs.get("momentum", 0.9)
         caxis = 1 if data.ndim > 1 else 0
-        red = tuple(i for i in range(data.ndim) if i != caxis)
         bshape = tuple(data.shape[caxis] if i == caxis else 1 for i in range(data.ndim))
         if attrs.get("fix_gamma", True):
             gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
-        # statistics in fp32 regardless of compute dtype: bf16 mean/var over
-        # large batches loses the small-difference precision BN depends on
-        data32 = data.astype(jnp.float32)
         use_global = attrs.get("use_global_stats", False) or not octx.is_train
         if use_global:
             mean, var = moving_mean, moving_var
             new_mm, new_mv = moving_mean, moving_var
+            inv = jax.lax.rsqrt(var + eps)
+            scale = (inv * gamma.astype(jnp.float32)).astype(data.dtype)
+            shift = (beta.astype(jnp.float32)
+                     - mean * inv * gamma.astype(jnp.float32)).astype(data.dtype)
+            out = data * scale.reshape(bshape) + shift.reshape(bshape)
         else:
-            mean = jnp.mean(data32, axis=red)
-            var = jnp.var(data32, axis=red)
+            out, mean, var = _bn_train_core(eps, caxis)(data, gamma, beta)
             new_mm = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
             new_mv = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
-        inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
-        out = (data32 - mean.reshape(bshape)) * inv \
-            * gamma.reshape(bshape).astype(jnp.float32) \
-            + beta.reshape(bshape).astype(jnp.float32)
-        return [out.astype(data.dtype), mean, var], [new_mm, new_mv]
+        return [out, mean, var], [new_mm, new_mv]
 
     register_op(OpDef(
         "BatchNorm", _batchnorm, schema=bn_schema,

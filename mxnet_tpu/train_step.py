@@ -80,6 +80,7 @@ class CompiledTrainStep:
                       for n in self._grad_names}
         self._fn = self._build()
         self.num_steps = 0
+        self._hyper_cache = None
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -144,6 +145,20 @@ class CompiledTrainStep:
                 data[name] = self._place(arr, name)
 
         lrs, wds, rescale, clip = self._optimizer.fused_hyper(self._grad_indices)
+        # keep hyper-params resident on device across steps: with a constant
+        # schedule this is one transfer total instead of one per step
+        cached = self._hyper_cache
+        if cached is not None and np.array_equal(cached[0], lrs) \
+                and np.array_equal(cached[1], wds) \
+                and cached[2] == rescale and cached[3] == clip:
+            lrs, wds, rescale, clip = cached[4]
+        else:
+            import jax
+
+            dev = (jax.device_put(lrs), jax.device_put(wds),
+                   jax.device_put(rescale), jax.device_put(clip))
+            self._hyper_cache = (lrs, wds, rescale, clip, dev)
+            lrs, wds, rescale, clip = dev
         rng = _rnd.split_key()
         self.params, self.slots, self.aux, outs = self._fn(
             self.params, self.slots, self.aux, data, lrs, wds, rescale, clip,
