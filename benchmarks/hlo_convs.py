@@ -1,16 +1,21 @@
-"""Dump the forward graph's HLO convolutions with shapes + estimated flops."""
+"""Count the forward graph's convolutions at the StableHLO level.
+
+Sanity tool: ResNet-50 must lower to exactly 53 convolutions + 1 dot.
+Run on CPU (structure only): JAX_PLATFORMS=cpu python benchmarks/hlo_convs.py
+"""
 import re
 import sys
+from collections import Counter
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import ndarray as nd
 from mxnet_tpu.models import resnet
 
-BATCH = 256
+BATCH = 8
 
 
 def main():
@@ -19,20 +24,16 @@ def main():
     mod = mx.mod.Module(net, context=ctx, compute_dtype="bfloat16")
     mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
              label_shapes=[("softmax_label", (BATCH,))])
-    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
-                                          factor_type="in", magnitude=2))
-    mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.1})
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
     step = mod._fused_step
     exe = step._exec
-    cdtype = jnp.bfloat16
-    params = {n: (v.astype(cdtype)
+    params = {n: (v.astype(jnp.bfloat16)
                   if jnp.issubdtype(v.dtype, jnp.floating) else v)
               for n, v in step.params.items()}
     aux = dict(step.aux)
-    x = jnp.zeros((BATCH, 3, 224, 224), cdtype)
-    y = jnp.zeros((BATCH,), jnp.float32)
-    data = {"data": x, "softmax_label": y}
+    data = {"data": jnp.zeros((BATCH, 3, 224, 224), jnp.bfloat16),
+            "softmax_label": jnp.zeros((BATCH,), jnp.float32)}
     key = jax.random.PRNGKey(0)
 
     def fwd_only(params, data, aux):
@@ -41,28 +42,16 @@ def main():
         outs, _ = exe._run_graph(env, aux, key, True)
         return outs
 
-    hlo = jax.jit(fwd_only).lower(params, data, aux).compile().as_text()
-    total = 0
-    n = 0
-    for line in hlo.splitlines():
-        if "convolution(" not in line and "convolution-base-dilated" not in line \
-                and " = convolution" not in line.replace("fusion", ""):
-            continue
-        m = re.search(r"(\w+\[[\d,]+\][^=]*)= convolution", line)
-        if not m:
-            continue
-        out = re.search(r"\[([\d,]+)\]", line)
-        shapes = re.findall(r"\[([\d,]+)\]", line)
-        # out shape, lhs shape, rhs shape
-        dims = re.search(r"dim_labels=(\S+)", line)
-        window = re.search(r"window={(.*?)}", line)
-        print("conv%-3d out=%s lhs=%s rhs=%s %s %s"
-              % (n, shapes[0], shapes[1] if len(shapes) > 1 else "?",
-                 shapes[2] if len(shapes) > 2 else "?",
-                 dims.group(1) if dims else "",
-                 (window.group(1)[:40] if window else "")))
-        n += 1
-    print("total convolution instructions:", n)
+    txt = jax.jit(fwd_only).lower(params, data, aux).as_text()
+    convs = re.findall(r"stablehlo\.convolution.*", txt)
+    dots = re.findall(r"stablehlo\.dot_general.*", txt)
+    print("convolutions: %d  dot_generals: %d" % (len(convs), len(dots)))
+    shapes = Counter()
+    for line in convs:
+        m = re.search(r"->\s*tensor<([^>]+)>", line)
+        shapes[m.group(1) if m else "?"] += 1
+    for shape, count in sorted(shapes.items()):
+        print("%3d x %s" % (count, shape))
 
 
 if __name__ == "__main__":

@@ -4,7 +4,10 @@ Times (a) forward-only, (b) forward+backward, (c) full fused step, and dumps
 XLA cost_analysis flops for each to compare against the analytic 4.1 GFLOP
 fwd / 12.3 GFLOP step per image.
 """
+import sys
 import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 import jax
 import jax.numpy as jnp

@@ -361,15 +361,15 @@ def register_all():
 
         def bn_bwd(res, cts):
             x, gamma, mean, inv = res
-            dy = cts[0]  # mean/var outputs feed stop_gradient'd aux updates
+            dy, dmean_ct, dvar_ct = cts
             red = tuple(i for i in range(x.ndim) if i != caxis)
             bshape = tuple(x.shape[caxis] if i == caxis else 1
                            for i in range(x.ndim))
             n = 1
             for i in red:
                 n *= x.shape[i]
-            xhat = (x.astype(jnp.float32) - mean.reshape(bshape)) \
-                * inv.reshape(bshape)
+            xmu = x.astype(jnp.float32) - mean.reshape(bshape)
+            xhat = xmu * inv.reshape(bshape)
             dy32 = dy.astype(jnp.float32)
             dbeta = jnp.sum(dy32, axis=red)
             dgamma = jnp.sum(dy32 * xhat, axis=red)
@@ -377,6 +377,10 @@ def register_all():
             dx = (inv * g32).reshape(bshape) \
                 * (dy32 - (dbeta / n).reshape(bshape)
                    - xhat * (dgamma / n).reshape(bshape))
+            # the mean/var outputs are separately consumable (output_mean_var,
+            # user head_grads); fold their cotangents in as well
+            dx = dx + (dmean_ct / n).reshape(bshape) \
+                + (dvar_ct * 2.0 / n).reshape(bshape) * xmu
             return dx.astype(x.dtype), dgamma.astype(gamma.dtype), \
                 dbeta.astype(gamma.dtype)
 

@@ -155,8 +155,11 @@ class CompiledTrainStep:
         else:
             import jax
 
-            dev = (jax.device_put(lrs), jax.device_put(wds),
-                   jax.device_put(rescale), jax.device_put(clip))
+            group = self._group
+            where = group._rep_sharding if group._mesh is not None \
+                else group.contexts[0].jax_device
+            dev = tuple(jax.device_put(v, where)
+                        for v in (lrs, wds, rescale, clip))
             self._hyper_cache = (lrs, wds, rescale, clip, dev)
             lrs, wds, rescale, clip = dev
         rng = _rnd.split_key()

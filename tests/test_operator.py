@@ -394,3 +394,41 @@ def test_grad_req_add():
     ex.forward(is_train=True)
     ex.backward()
     np.testing.assert_allclose(grad.asnumpy(), init_grad + 2 * x, rtol=1e-4)
+
+
+def test_batchnorm_through_statistics_grad():
+    """BN's custom_vjp must honor cotangents arriving via the mean/var
+    outputs (output_mean_var consumers), not just the normalized output."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.registry import get_op, OpContext
+
+    x = jnp.asarray(rng.randn(4, 3, 5, 5).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(3).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(3).astype(np.float32))
+    mm, mv = jnp.zeros(3), jnp.ones(3)
+    op = get_op("BatchNorm")
+    attrs = {"eps": 1e-3, "momentum": 0.9, "fix_gamma": False}
+
+    def f(x, gamma, beta):
+        outs, _ = op.fcompute(attrs, [x, gamma, beta], [mm, mv],
+                              OpContext(is_train=True, rng=None))
+        out, mean, var = outs
+        return jnp.sum(out * out) + 3.0 * jnp.sum(mean) \
+            + 2.0 * jnp.sum(var * var)
+
+    def ref(x, gamma, beta):
+        red, b = (0, 2, 3), (1, 3, 1, 1)
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+        inv = jax.lax.rsqrt(var.reshape(b) + 1e-3)
+        out = (x - mean.reshape(b)) * inv * gamma.reshape(b) + beta.reshape(b)
+        return jnp.sum(out * out) + 3.0 * jnp.sum(mean) \
+            + 2.0 * jnp.sum(var * var)
+
+    g1 = jax.grad(f, argnums=(0, 1, 2))(x, gamma, beta)
+    g2 = jax.grad(ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
