@@ -1,0 +1,166 @@
+"""Raw-JAX ResNet-50 v2 fwd+bwd+SGD, NCHW vs NHWC, to find the chip ceiling."""
+import os
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+N = int(os.environ.get("N", "256"))
+LAYOUT = os.environ.get("LAYOUT", "NHWC")
+CAXIS = 1 if LAYOUT == "NCHW" else 3
+DN = ("NCHW", "OIHW", "NCHW") if LAYOUT == "NCHW" else ("NHWC", "HWIO", "NHWC")
+
+S2D = os.environ.get("S2D", "0") == "1"  # space-to-depth conv0 (MLPerf trick)
+
+rng = np.random.RandomState(0)
+params = {}
+FLOPS = [0]
+
+
+def conv_w(name, cin, cout, k):
+    shape = (cout, cin, k, k) if LAYOUT == "NCHW" else (k, k, cin, cout)
+    params[name] = jnp.asarray(rng.normal(0, 0.05, shape), jnp.float32)
+
+
+def bn_w(name, c):
+    params[name + "_g"] = jnp.ones((c,), jnp.float32)
+    params[name + "_b"] = jnp.zeros((c,), jnp.float32)
+
+
+def conv(p, name, x, k, s):
+    w = p[name].astype(jnp.bfloat16)
+    pad = k // 2
+    cin = w.shape[1] if LAYOUT == "NCHW" else w.shape[2]
+    cout = w.shape[0] if LAYOUT == "NCHW" else w.shape[3]
+    h = x.shape[2 if LAYOUT == "NCHW" else 1]
+    ho = (h + 2 * pad - k) // s + 1
+    FLOPS[0] += 2 * N * cout * cin * k * k * ho * ho
+    return lax.conv_general_dilated(x, w, (s, s), [(pad, pad)] * 2,
+                                    dimension_numbers=DN)
+
+
+BN_MODE = os.environ.get("BN", "naive")
+
+
+def bn_relu(p, name, x, relu=True):
+    if BN_MODE == "none":
+        return jnp.maximum(x, 0) if relu else x
+    red = tuple(i for i in range(4) if i != CAXIS)
+    bshape = tuple(x.shape[CAXIS] if i == CAXIS else 1 for i in range(4))
+    x32 = x.astype(jnp.float32) if BN_MODE != "bf16" else x
+    m = jnp.mean(x32, axis=red)
+    v = jnp.var(x32, axis=red)
+    if BN_MODE == "bf16":
+        m, v = m.astype(jnp.float32), v.astype(jnp.float32)
+    inv = lax.rsqrt(v + 2e-5)
+    scale = (inv * p[name + "_g"]).astype(x.dtype).reshape(bshape)
+    shift = (p[name + "_b"] - m * inv * p[name + "_g"]).astype(x.dtype).reshape(bshape)
+    y = x * scale + shift
+    return jnp.maximum(y, 0) if relu else y
+
+
+UNITS = [3, 4, 6, 3]
+FILTERS = [256, 512, 1024, 2048]
+
+# build params
+if S2D:
+    conv_w("conv0", 12, 64, 4)  # 2x2 space-to-depth: 224x224x3 -> 112x112x12
+else:
+    conv_w("conv0", 3, 64, 7)
+bn_w("bn0", 64)
+cin = 64
+for si, (u, f) in enumerate(zip(UNITS, FILTERS)):
+    mid = f // 4
+    for ui in range(u):
+        nm = f"s{si}u{ui}"
+        bn_w(nm + "_bn1", cin)
+        conv_w(nm + "_c1", cin, mid, 1)
+        bn_w(nm + "_bn2", mid)
+        conv_w(nm + "_c2", mid, mid, 3)
+        bn_w(nm + "_bn3", mid)
+        conv_w(nm + "_c3", mid, f, 1)
+        if ui == 0:
+            conv_w(nm + "_sc", cin, f, 1)
+        cin = f
+bn_w("bn_final", 2048)
+params["fc_w"] = jnp.asarray(rng.normal(0, 0.01, (2048, 1000)), jnp.float32)
+params["fc_b"] = jnp.zeros((1000,), jnp.float32)
+
+
+def forward(p, x, y):
+    if S2D:
+        # x arrives pre-space-to-depth'd as (N,112,112,12); 4x4/s2 conv == 7x7/s2
+        # on the original image up to the (negligible) 8th tap row/col
+        h = conv(p, "conv0", x, 4, 1)
+    else:
+        h = conv(p, "conv0", x, 7, 2)
+    h = bn_relu(p, "bn0", h)
+    # maxpool 3x3 s2
+    pads = [(0, 0)] * 4
+    pads[2 if LAYOUT == "NCHW" else 1] = (1, 1)
+    pads[3 if LAYOUT == "NCHW" else 2] = (1, 1)
+    win = [1, 1, 3, 3] if LAYOUT == "NCHW" else [1, 3, 3, 1]
+    st = [1, 1, 2, 2] if LAYOUT == "NCHW" else [1, 2, 2, 1]
+    h = lax.reduce_window(h, -jnp.inf, lax.max, win, st, pads)
+    cin = 64
+    for si, (u, f) in enumerate(zip(UNITS, FILTERS)):
+        mid = f // 4
+        for ui in range(u):
+            nm = f"s{si}u{ui}"
+            s = 2 if (ui == 0 and si > 0) else 1
+            a1 = bn_relu(p, nm + "_bn1", h)
+            c1 = conv(p, nm + "_c1", a1, 1, 1)
+            a2 = bn_relu(p, nm + "_bn2", c1)
+            c2 = conv(p, nm + "_c2", a2, 3, s)
+            a3 = bn_relu(p, nm + "_bn3", c2)
+            c3 = conv(p, nm + "_c3", a3, 1, 1)
+            sc = conv(p, nm + "_sc", a1, 1, s) if ui == 0 else h
+            h = c3 + sc
+            cin = f
+    h = bn_relu(p, "bn_final", h)
+    h = jnp.mean(h.astype(jnp.float32), axis=tuple(i for i in range(1, 4) if i != CAXIS))
+    logits = h @ p["fc_w"] + p["fc_b"]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, y[:, None], axis=1)[:, 0]
+    return jnp.mean(lse - ll)
+
+
+MODE = os.environ.get("MODE", "train")
+
+
+def train(p, mom, x, y):
+    if MODE == "fwd":
+        return p, mom, forward(p, x, y)
+    loss, g = jax.value_and_grad(forward)(p, x, y)
+    newp, newm = {}, {}
+    for k in p:
+        m = 0.9 * mom[k] + g[k]
+        newm[k] = m
+        newp[k] = p[k] - 0.1 * m
+    return newp, newm, loss
+
+
+mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+if LAYOUT == "NCHW":
+    x = jnp.asarray(rng.rand(N, 3, 224, 224), jnp.bfloat16)
+elif S2D:
+    x = jnp.asarray(rng.rand(N, 112, 112, 12), jnp.bfloat16)
+else:
+    x = jnp.asarray(rng.rand(N, 224, 224, 3), jnp.bfloat16)
+y = jnp.asarray(rng.randint(0, 1000, (N,)), jnp.int32)
+
+f = jax.jit(train, donate_argnums=(0, 1))
+t0 = time.time()
+params, mom, loss = f(params, mom, x, y)
+float(loss)
+print(f"compile+first: {time.time()-t0:.1f}s, flops/step counted={FLOPS[0]/1e12:.2f}T (fwd only)", flush=True)
+t0 = time.time()
+iters = 20
+for _ in range(iters):
+    params, mom, loss = f(params, mom, x, y)
+float(loss)
+dt = (time.time() - t0) / iters
+tf = 3 * FLOPS[0] / dt / 1e12
+print(f"{LAYOUT} N={N}: {dt*1e3:.1f} ms/step, {N/dt:.0f} img/s, "
+      f"{tf:.1f} TFLOP/s, MFU {tf/197*100:.1f}%", flush=True)
