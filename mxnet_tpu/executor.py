@@ -254,10 +254,12 @@ class Executor:
         self._last_rng = rng  # reused by backward(out_grads): same dropout masks
 
         tap = None
-        if self._monitor_callback is not None:
+        if self._monitor_callback is not None and \
+                getattr(self._monitor_callback, "active", True):
             # monitored runs execute eagerly (the NaiveEngine analog) so
             # every op's output exists to be observed — reference taps each
-            # node in graph_executor.cc:758-778
+            # node in graph_executor.cc:758-778.  A disarmed tap (Monitor
+            # between intervals) keeps the fast jitted path.
             cb = self._monitor_callback
 
             def tap(name, value):
@@ -345,7 +347,15 @@ class Executor:
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Rebind with new input shapes; jit specializes per shape the same
-        way bucketing shares memory pools in the reference."""
+        way bucketing shares memory pools in the reference.
+
+        Contract (reference executor.py reshape): shapes of arguments *not*
+        named in kwargs may only change when ``partial_shaping`` is set, and
+        any array may only grow when ``allow_up_sizing`` is set (the
+        reference reuses the old buffer's memory, so growth needs opt-in;
+        here growth allocates a fresh buffer but the contract is enforced
+        identically so programs behave the same on both frameworks).
+        """
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         new_args, new_grads = {}, {}
         for name, shape, arr in zip(self._arg_names, arg_shapes, self.arg_arrays):
@@ -354,6 +364,17 @@ class Executor:
                 if name in self.grad_dict:
                     new_grads[name] = self.grad_dict[name]
             else:
+                if not partial_shaping and name not in kwargs:
+                    raise MXNetError(
+                        "Shape of unspecified argument %r changed (%s -> %s);"
+                        " pass partial_shaping=True to allow this" %
+                        (name, arr.shape, tuple(shape)))
+                if not allow_up_sizing and \
+                        int(np.prod(shape)) > int(np.prod(arr.shape)):
+                    raise MXNetError(
+                        "New shape of %r is larger than the original (%s -> "
+                        "%s); pass allow_up_sizing=True to allow this" %
+                        (name, arr.shape, tuple(shape)))
                 new_args[name] = nd.zeros(shape, self._ctx, dtype=arr.dtype)
                 if name in self.grad_dict:
                     new_grads[name] = nd.zeros(shape, self._ctx, dtype=arr.dtype)

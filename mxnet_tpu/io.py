@@ -11,6 +11,7 @@ runtime).
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 import threading
@@ -227,6 +228,12 @@ class MNISTIter(DataIter):
             images = _read_idx(image)
             labels = _read_idx(label)
         else:
+            if not silent:
+                logging.warning(
+                    "MNISTIter: idx files %r not found; substituting a "
+                    "deterministic SYNTHETIC dataset (accuracy numbers will "
+                    "not be comparable to real MNIST). Pass silent=True to "
+                    "suppress.", image)
             images, labels = _synthetic_mnist(seed=seed)
         images = images.astype(np.float32) / 255.0
         if num_parts > 1:

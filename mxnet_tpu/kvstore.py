@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import zlib
 
 from .base import MXNetError
 from . import ndarray as nd
@@ -93,7 +94,12 @@ class KVStore:
 
     @staticmethod
     def _str_to_int(k):
-        return k if isinstance(k, int) else abs(hash(k)) % (1 << 31)
+        # crc32 is stable across processes/runs (unlike str.__hash__, which is
+        # salted per interpreter) so optimizer-state indices agree between
+        # workers and across save/load.
+        if isinstance(k, int):
+            return k
+        return zlib.crc32(k.encode("utf-8")) & 0x7FFFFFFF
 
     # -- updater / optimizer ----------------------------------------------
     def set_updater(self, updater):

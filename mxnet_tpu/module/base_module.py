@@ -62,9 +62,13 @@ class BaseModule:
 
     @staticmethod
     def _unpadded(batch, outputs):
-        """Strip the iterator's tail padding from a batch's outputs."""
-        n = outputs[0].shape[0] - batch.pad
-        return [out[:n] for out in outputs]
+        """Strip the iterator's tail padding from a batch's outputs.
+
+        Each output is sliced by its own leading dim, so a scalar/aggregated
+        loss output alongside per-sample outputs is not mis-sliced.
+        """
+        return [out[:out.shape[0] - batch.pad] if out.ndim > 0 else out
+                for out in outputs]
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,

@@ -25,6 +25,20 @@ def _default_stat(x):
     return nd.norm(x) / (x.size ** 0.5)
 
 
+class _Tap:
+    """Executor-facing callback wrapper exposing the monitor's armed state."""
+
+    def __init__(self, monitor):
+        self._monitor = monitor
+
+    def __call__(self, name, array):
+        self._monitor._observe(name, array)
+
+    @property
+    def active(self):
+        return self._monitor._collecting
+
+
 class Monitor:
     """Collects ``(step, name, stat)`` records during monitored batches.
 
@@ -45,8 +59,14 @@ class Monitor:
 
     # -- executor hookup ---------------------------------------------------
     def install(self, exe):
-        """Register this monitor's tap with an executor."""
-        exe.set_monitor_callback(self._observe)
+        """Register this monitor's tap with an executor.
+
+        The tap carries an ``active`` property so the executor can keep
+        non-collecting batches on the fast jitted path — the eager per-op
+        pass only runs on the 1-in-``interval`` armed batches (the
+        reference's inactive taps are similarly near-free no-ops).
+        """
+        exe.set_monitor_callback(_Tap(self))
         self._executors.append(exe)
 
     def _observe(self, name, array):

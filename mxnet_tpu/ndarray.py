@@ -15,6 +15,7 @@ op registry at import, mirroring `_init_ndarray_module`
 """
 from __future__ import annotations
 
+import functools
 import struct
 from collections import deque
 
@@ -370,11 +371,31 @@ def concatenate(arrays, axis=0, always_copy=True):
     return NDArray(jnp.concatenate([a.data for a in arrays], axis=axis), arrays[0]._ctx)
 
 
-def waitall():
-    """Block on recently dispatched work (reference: Engine::WaitForAll)."""
+@functools.lru_cache(maxsize=None)
+def _fence_fn():
     import jax
+
+    return jax.jit(lambda v: v + 1)
+
+
+def waitall():
+    """Block on ALL dispatched work (reference: Engine::WaitForAll).
+
+    Two layers: drain the ring of recently produced arrays, then push a
+    trivial fence computation onto every local device and block on it —
+    XLA's per-device execution streams are FIFO, so the fence completing
+    means everything enqueued before it has completed, including work whose
+    result arrays fell out of the ring.
+    """
+    import jax
+    import jax.numpy as jnp
+
     while _RECENT:
         jax.block_until_ready(_RECENT.popleft())
+    fence = _fence_fn()
+    for dev in jax.local_devices():
+        x = jax.device_put(jnp.zeros((), jnp.float32), dev)
+        jax.block_until_ready(fence(x))
 
 
 # ---------------------------------------------------------------------------

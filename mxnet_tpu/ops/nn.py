@@ -75,13 +75,36 @@ def _conv_shape(attrs, in_shapes, aux_shapes):
     return shapes, [(n, nf, oh, ow)], []
 
 
+def _deconv_pad(attrs, h, w):
+    """Resolve Deconvolution pad/adj; ``target_shape`` overrides both so the
+    output spatial dims come out exactly as requested (reference:
+    deconvolution-inl.h InferPad — pad = ceil(d/2), adj = d%2 where
+    d = stride*(in-1)+kernel-target)."""
+    kh, kw = _pair(attrs["kernel"])
+    sh, sw = _pair(attrs.get("stride", (1, 1)))
+    target = tuple(attrs.get("target_shape", ()) or ())
+    if target:
+        th, tw = _pair(target)
+        dh = (h - 1) * sh + kh - th
+        dw = (w - 1) * sw + kw - tw
+        if dh < 0 or dw < 0:
+            raise ValueError(
+                "Deconvolution target_shape %s is larger than the maximum "
+                "output %s for input %s" % (target, ((h - 1) * sh + kh,
+                                                     (w - 1) * sw + kw),
+                                            (h, w)))
+        return (dh + 1) // 2, (dw + 1) // 2, dh % 2, dw % 2
+    ph, pw = _pair(attrs.get("pad", (0, 0)))
+    ah, aw = _pair(attrs.get("adj", (0, 0)))
+    return ph, pw, ah, aw
+
+
 def _deconv_shape(attrs, in_shapes, aux_shapes):
     dshape = in_shapes[0]
     n, c, h, w = dshape
     kh, kw = _pair(attrs["kernel"])
     sh, sw = _pair(attrs.get("stride", (1, 1)))
-    ph, pw = _pair(attrs.get("pad", (0, 0)))
-    ah, aw = _pair(attrs.get("adj", (0, 0)))
+    ph, pw, ah, aw = _deconv_pad(attrs, h, w)
     nf = attrs["num_filter"]
     ng = attrs.get("num_group", 1)
     wshape = (c, nf // ng, kh, kw)
@@ -239,8 +262,7 @@ def register_all():
     def _deconv(attrs, data, weight, *bias):
         kh, kw = _pair(attrs["kernel"])
         sh, sw = _pair(attrs.get("stride", (1, 1)))
-        ph, pw = _pair(attrs.get("pad", (0, 0)))
-        ah, aw = _pair(attrs.get("adj", (0, 0)))
+        ph, pw, ah, aw = _deconv_pad(attrs, data.shape[2], data.shape[3])
         ng = attrs.get("num_group", 1)
         # deconv = gradient of conv: dilate lhs by stride, full-minus-pad padding,
         # kernel flipped spatially and IO-transposed (weight is (C, F/g, kh, kw))

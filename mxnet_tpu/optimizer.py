@@ -139,13 +139,25 @@ class Optimizer:
         falls back to the eager update path):
 
         * ``make_slots(w)``: jnp weight -> tuple of jnp slot arrays
-        * ``apply(w, g, slots, lr, wd, rescale, clip)``: all-jnp update;
-          ``lr`` arrives already bias-corrected/scheduled (host-side, like
-          the eager ``update()``); ``rescale``/``clip`` are runtime scalars
-          so later mutation of ``self.rescale_grad`` etc. is honored without
-          recompiling (clip <= 0 means no clipping).
+        * ``apply(w, g, slots, lr, wd, rescale, clip, extra)``: all-jnp
+          update; ``lr`` arrives already bias-corrected/scheduled
+          (host-side, like the eager ``update()``); ``rescale``/``clip``
+          and the ``extra`` vector (``fused_extra()`` — momentum/betas/
+          epsilon) are runtime scalars so later mutation of
+          ``self.momentum`` etc. is honored without recompiling
+          (clip <= 0 means no clipping).  Only *structural* choices
+          (whether momentum slots exist at all, centered RMSProp) are
+          baked at build time.
         """
         return None
+
+    def fused_extra(self):
+        """Runtime hyper-vector consumed by ``apply``'s ``extra`` argument.
+
+        Re-read from ``self`` every step, so mutating hyperparameters after
+        the fused step compiled keeps fused and eager paths in agreement.
+        """
+        return np.zeros(0, np.float32)
 
     def fused_hyper(self, indices):
         """Host-side per-step hyperparams for the fused step: bumps update
@@ -238,21 +250,27 @@ class SGD(Optimizer):
     def fused_kernel(self):
         import jax.numpy as jnp
 
-        momentum = self.momentum
+        # slot *structure* is compile-time; the momentum value itself rides
+        # in `extra` so post-compile mutation stays honored
+        has_momentum = self.momentum != 0.0
 
         def make_slots(w):
-            return (jnp.zeros_like(w),) if momentum != 0.0 else ()
+            return (jnp.zeros_like(w),) if has_momentum else ()
 
-        def apply(w, g, slots, lr, wd, rescale, clip):
+        def apply(w, g, slots, lr, wd, rescale, clip, extra):
             g = g * rescale
             g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-            if momentum != 0.0:
+            if has_momentum:
+                momentum = extra[0]
                 (m,) = slots
                 m = momentum * m - lr * (g + wd * w)
                 return w + m, (m,)
             return w - lr * (g + wd * w), ()
 
         return make_slots, apply
+
+    def fused_extra(self):
+        return np.array([self.momentum], np.float32)
 
     def update_multi(self, indices, weights, grads, states):
         for i in indices:
@@ -280,16 +298,17 @@ class NAG(SGD):
     def fused_kernel(self):
         import jax.numpy as jnp
 
-        momentum = self.momentum
+        has_momentum = self.momentum != 0.0
 
         def make_slots(w):
-            return (jnp.zeros_like(w),) if momentum != 0.0 else ()
+            return (jnp.zeros_like(w),) if has_momentum else ()
 
-        def apply(w, g, slots, lr, wd, rescale, clip):
+        def apply(w, g, slots, lr, wd, rescale, clip, extra):
             g = g * rescale
             g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
             g = g + wd * w
-            if momentum != 0.0:
+            if has_momentum:
+                momentum = extra[0]
                 (m,) = slots
                 m = momentum * m + g
                 return w - lr * (g + momentum * m), (m,)
@@ -387,12 +406,11 @@ class Adam(Optimizer):
     def fused_kernel(self):
         import jax.numpy as jnp
 
-        beta1, beta2, eps = self.beta1, self.beta2, self.epsilon
-
         def make_slots(w):
             return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-        def apply(w, g, slots, lr, wd, rescale, clip):
+        def apply(w, g, slots, lr, wd, rescale, clip, extra):
+            beta1, beta2, eps = extra[0], extra[1], extra[2]
             mean, var = slots
             g = g * rescale
             g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -402,6 +420,9 @@ class Adam(Optimizer):
             return w - lr * mean / (jnp.sqrt(var) + eps), (mean, var)
 
         return make_slots, apply
+
+    def fused_extra(self):
+        return np.array([self.beta1, self.beta2, self.epsilon], np.float32)
 
     def fused_hyper(self, indices):
         lrs, wds, rescale, clip = super().fused_hyper(indices)
@@ -442,12 +463,11 @@ class AdaGrad(Optimizer):
     def fused_kernel(self):
         import jax.numpy as jnp
 
-        eps = self.float_stable_eps
-
         def make_slots(w):
             return (jnp.zeros_like(w),)
 
-        def apply(w, g, slots, lr, wd, rescale, clip):
+        def apply(w, g, slots, lr, wd, rescale, clip, extra):
+            eps = extra[0]
             (h,) = slots
             g = g * rescale
             g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -455,6 +475,9 @@ class AdaGrad(Optimizer):
             return w - lr * (g / jnp.sqrt(h + eps) + wd * w), (h,)
 
         return make_slots, apply
+
+    def fused_extra(self):
+        return np.array([self.float_stable_eps], np.float32)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -495,15 +518,14 @@ class RMSProp(Optimizer):
     def fused_kernel(self):
         import jax.numpy as jnp
 
-        rho, mom, eps = self.gamma1, self.gamma2, self.epsilon
-        centered = self.centered
-        cw = self.clip_weights if self.clip_weights else -1.0
+        centered = self.centered  # structural: decides the slot count
 
         def make_slots(w):
             n = 3 if centered else 1
             return tuple(jnp.zeros_like(w) for _ in range(n))
 
-        def apply(w, g, slots, lr, wd, rescale, clip):
+        def apply(w, g, slots, lr, wd, rescale, clip, extra):
+            rho, mom, eps, cw = extra[0], extra[1], extra[2], extra[3]
             g = g * rescale
             g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
             g = g + wd * w
@@ -519,11 +541,15 @@ class RMSProp(Optimizer):
                 n = rho * n + (1 - rho) * jnp.square(g)
                 w = w - lr * g / jnp.sqrt(n + eps)
                 new_slots = (n,)
-            if cw > 0:
-                w = jnp.clip(w, -cw, cw)
+            w = jnp.where(cw > 0, jnp.clip(w, -cw, cw), w)
             return w, new_slots
 
         return make_slots, apply
+
+    def fused_extra(self):
+        cw = self.clip_weights if self.clip_weights else -1.0
+        return np.array([self.gamma1, self.gamma2, self.epsilon, cw],
+                        np.float32)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -651,6 +677,19 @@ class Updater:
         converted = {}
         for key, state in loaded.items():
             idx = name2idx.get(key, key) if isinstance(key, str) else key
+            if isinstance(idx, str):
+                # an unmapped name key would silently shadow-miss in
+                # __call__ (which looks up integer indices) and restart the
+                # state from zeros — losing momentum/moments on resume
+                import logging
+
+                detail = ("optimizer.idx2name is empty — was the optimizer "
+                          "passed to init_optimizer as an instance?"
+                          if not name2idx else
+                          "known names: %s" % sorted(name2idx))
+                logging.warning(
+                    "optimizer state key %r has no index mapping (%s); its "
+                    "saved state will not be applied", key, detail)
             if isinstance(state, tuple) and all(
                     isinstance(s, np.ndarray) for s in state):
                 import jax.numpy as jnp

@@ -99,7 +99,8 @@ class CompiledTrainStep:
                 return v.astype(cdtype)
             return v
 
-        def step(params, slots, aux, data, lrs, wds, rescale, clip, rng):
+        def step(params, slots, aux, data, lrs, wds, rescale, clip, extra,
+                 rng):
             castp = {n: cast(v) for n, v in params.items()}
             # labels keep their dtype (integer class ids beyond bf16's exact
             # range must survive); only data inputs are cast
@@ -123,7 +124,7 @@ class CompiledTrainStep:
             for i, n in enumerate(grad_names):
                 g = grads[i].astype(params[n].dtype)
                 w, s = opt_apply(params[n], g, slots[n],
-                                 lrs[i], wds[i], rescale, clip)
+                                 lrs[i], wds[i], rescale, clip, extra)
                 new_params[n] = w
                 new_slots[n] = s
             new_aux = {n: v.astype(aux[n].dtype)
@@ -141,17 +142,24 @@ class CompiledTrainStep:
         for name, arr in zip(self._data_names, data_batch.data):
             data[name] = self._place(arr, name)
         if self._label_names and data_batch.label:
-            for name, arr in zip(self._label_names, data_batch.label):
-                data[name] = self._place(arr, name)
+            # zip the *unfiltered* group label list so an unconsumed early
+            # label cannot shift later labels onto the wrong arrays; names
+            # the symbol doesn't take are skipped in-loop (same alignment
+            # rule as DataParallelExecutorGroup.forward)
+            for name, arr in zip(self._group.label_names, data_batch.label):
+                if name in self._label_names:
+                    data[name] = self._place(arr, name)
 
         lrs, wds, rescale, clip = self._optimizer.fused_hyper(self._grad_indices)
+        extra = self._optimizer.fused_extra()
         # keep hyper-params resident on device across steps: with a constant
         # schedule this is one transfer total instead of one per step
         cached = self._hyper_cache
         if cached is not None and np.array_equal(cached[0], lrs) \
                 and np.array_equal(cached[1], wds) \
-                and cached[2] == rescale and cached[3] == clip:
-            lrs, wds, rescale, clip = cached[4]
+                and cached[2] == rescale and cached[3] == clip \
+                and np.array_equal(cached[4], extra):
+            lrs, wds, rescale, clip, extra = cached[5]
         else:
             import jax
 
@@ -159,13 +167,13 @@ class CompiledTrainStep:
             where = group._rep_sharding if group._mesh is not None \
                 else group.contexts[0].jax_device
             dev = tuple(jax.device_put(v, where)
-                        for v in (lrs, wds, rescale, clip))
-            self._hyper_cache = (lrs, wds, rescale, clip, dev)
-            lrs, wds, rescale, clip = dev
+                        for v in (lrs, wds, rescale, clip, extra))
+            self._hyper_cache = (lrs, wds, rescale, clip, extra, dev)
+            lrs, wds, rescale, clip, extra = dev
         rng = _rnd.split_key()
         self.params, self.slots, self.aux, outs = self._fn(
             self.params, self.slots, self.aux, data, lrs, wds, rescale, clip,
-            rng)
+            extra, rng)
         self.num_steps += 1
         return outs
 

@@ -48,9 +48,33 @@ def test_simple_bind_shares_shapes():
 def test_reshape_executor():
     net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
     ex = net.simple_bind(mx.cpu(), data=(5, 3))
-    ex2 = ex.reshape(data=(10, 3))
+    ex2 = ex.reshape(allow_up_sizing=True, data=(10, 3))
     assert ex2.arg_dict["data"].shape == (10, 3)
     assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    # shrinking needs no opt-in
+    ex3 = ex.reshape(data=(2, 3))
+    assert ex3.arg_dict["data"].shape == (2, 3)
+
+
+def test_reshape_contract():
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(5, 3))
+    # growing an array requires allow_up_sizing (reference reuses memory)
+    with pytest.raises(MXNetError):
+        ex.reshape(data=(10, 3))
+    # a conv net where the weight would implicitly change shape needs
+    # partial_shaping; FC weight shape is input-dependent via num input dims
+    net2 = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    ex2 = net2.simple_bind(mx.cpu(), data=(5, 3))
+    with pytest.raises(MXNetError):
+        ex2.reshape(data=(5, 7))  # fc_weight (4,3)->(4,7) unspecified change
+    out = ex2.reshape(partial_shaping=True, allow_up_sizing=True,
+                      data=(5, 7))
+    assert out.arg_dict["fc_weight"].shape == (4, 7)
 
 
 def test_multi_output_executor():

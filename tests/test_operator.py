@@ -432,3 +432,20 @@ def test_batchnorm_through_statistics_grad():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_deconvolution_target_shape():
+    # target_shape overrides pad/adj so output spatial dims come out exact
+    data = sym.Variable("data")
+    net = sym.Deconvolution(data, kernel=(4, 4), stride=(2, 2),
+                            target_shape=(8, 8), num_filter=2, name="dc")
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 4, 4))
+    assert out_shapes[0] == (1, 2, 8, 8)
+    ex = net.simple_bind(mx.cpu(), data=(1, 3, 4, 4))
+    ex.forward()
+    assert ex.outputs[0].shape == (1, 2, 8, 8)
+    # odd gap exercises the adj = d%2 path
+    net2 = sym.Deconvolution(data, kernel=(3, 3), stride=(2, 2),
+                             target_shape=(7, 7), num_filter=2, name="dc2")
+    _, out_shapes2, _ = net2.infer_shape(data=(1, 3, 4, 4))
+    assert out_shapes2[0] == (1, 2, 7, 7)
