@@ -1,16 +1,21 @@
-"""Image pipeline: record-backed and list-backed image iterators + augmenters.
+"""Image pipeline: decode, geometric/photometric augmenters, image iterators.
 
-Reference: `src/io/iter_image_recordio.cc` (threaded decode + augment chain)
-and `python/mxnet/image.py` (pure-python pipeline).  TPU-native: numpy
-augmenters on a host worker thread (PrefetchingIter) feeding device batches;
-JPEG decode uses cv2 when present, else the raw-array codec from recordio.
-A C++ reader for the hot path lives in src/ (native runtime).
+Capability parity with the reference's ``python/mxnet/image.py`` +
+``src/io/iter_image_recordio.cc`` / ``image_aug_default.cc``, re-designed:
+
+* augmenters are single-image -> single-image callables with an explicit
+  per-pipeline ``numpy.random.Generator`` (reproducible via ``seed``;
+  the reference uses process-global RNG state);
+* the sample stream is split out into small Source objects (record file,
+  image list / directory) so the iterator body is only batching+augmenting;
+* batches are assembled HWC and transposed to NCHW once, at the end.
+
+Decode uses cv2 when available and falls back to the raw-array codec in
+``recordio`` otherwise (TPU hosts often have no OpenCV).
 """
 from __future__ import annotations
 
 import os
-import random as pyrandom
-import threading
 
 import numpy as np
 
@@ -21,391 +26,469 @@ from . import recordio
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
-           "random_crop", "center_crop", "color_normalize", "random_size_crop",
-           "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
-           "RandomOrderAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "ResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+           "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
            "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
            "ImageRecordIter"]
+
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32)  # ITU-R BT.601
 
 
 def _cv2():
     try:
         import cv2
-
         return cv2
     except ImportError:
         return None
 
 
 def imdecode(buf, flag=1, to_rgb=True):
-    """Decode an image buffer to HWC uint8 numpy (reference: image.py:32)."""
+    """Decode a compressed image buffer to an HWC uint8 array."""
     cv2 = _cv2()
-    if cv2 is not None:
-        img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
-        if img is None:
-            raise MXNetError("imdecode failed")
-        if to_rgb:
-            img = img[:, :, ::-1]
-        return img
-    raise MXNetError("imdecode requires cv2; use raw-array records instead")
+    if cv2 is None:
+        raise MXNetError("imdecode needs cv2; store raw-array records when "
+                         "OpenCV is unavailable")
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+    if img is None:
+        raise MXNetError("imdecode failed (truncated or unsupported buffer)")
+    return img[:, :, ::-1] if to_rgb else img
 
 
 def _resize(img, w, h, interp=1):
     cv2 = _cv2()
     if cv2 is not None:
         return cv2.resize(img, (w, h), interpolation=interp)
-    # nearest-neighbor fallback
-    ys = (np.arange(h) * img.shape[0] / h).astype(np.int64)
-    xs = (np.arange(w) * img.shape[1] / w).astype(np.int64)
-    return img[ys][:, xs]
+    # nearest-neighbor fallback via index maps
+    rows = np.minimum((np.arange(h) * img.shape[0]) // h, img.shape[0] - 1)
+    cols = np.minimum((np.arange(w) * img.shape[1]) // w, img.shape[1] - 1)
+    return img[rows[:, None], cols[None, :]]
+
+
+# -- functional geometry ----------------------------------------------------
 
 
 def scale_down(src_size, size):
-    w, h = size
+    """Shrink the requested crop size to fit inside the source, keeping
+    aspect."""
     sw, sh = src_size
+    w, h = size
     if sh < h:
-        w, h = float(w * sh) / h, sh
+        w, h = w * sh / h, sh
     if sw < w:
-        w, h = sw, float(h * sw) / w
+        w, h = sw, h * sw / w
     return int(w), int(h)
 
 
 def resize_short(src, size, interp=2):
+    """Resize so the SHORTER edge equals ``size`` exactly (the longer edge
+    rounds to preserve aspect)."""
     h, w = src.shape[:2]
-    if h > w:
-        new_h, new_w = size * h // w, size
+    if h <= w:
+        new_h, new_w = size, max(1, int(round(w * size / h)))
     else:
-        new_h, new_w = size, size * w // h
+        new_h, new_w = max(1, int(round(h * size / w))), size
     return _resize(src, new_w, new_h, interp)
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
-    out = src[y0:y0 + h, x0:x0 + w]
-    if size is not None and (w, h) != size:
-        out = _resize(out, size[0], size[1], interp)
-    return out
+    window = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and size != (w, h):
+        window = _resize(window, size[0], size[1], interp)
+    return window
 
 
-def random_crop(src, size, interp=2):
+def _rng_of(rng):
+    return rng if rng is not None else np.random.default_rng()
+
+
+def random_crop(src, size, interp=2, rng=None):
+    rng = _rng_of(rng)
     h, w = src.shape[:2]
-    new_w, new_h = scale_down((w, h), size)
-    x0 = pyrandom.randint(0, w - new_w)
-    y0 = pyrandom.randint(0, h - new_h)
-    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    cw, ch = scale_down((w, h), size)
+    x0 = int(rng.integers(0, w - cw + 1))
+    y0 = int(rng.integers(0, h - ch + 1))
+    return fixed_crop(src, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
 
 
 def center_crop(src, size, interp=2):
     h, w = src.shape[:2]
-    new_w, new_h = scale_down((w, h), size)
-    x0 = (w - new_w) // 2
-    y0 = (h - new_h) // 2
-    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    cw, ch = scale_down((w, h), size)
+    x0, y0 = (w - cw) // 2, (h - ch) // 2
+    return fixed_crop(src, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
 
 
-def color_normalize(src, mean, std=None):
-    src = src.astype(np.float32) - mean
-    if std is not None:
-        src /= std
-    return src
-
-
-def random_size_crop(src, size, min_area, ratio, interp=2):
+def random_size_crop(src, size, min_area, ratio, interp=2, rng=None,
+                     attempts=10):
+    """Crop a random area/aspect window (Inception-style), falling back to a
+    center crop when no attempt fits."""
+    rng = _rng_of(rng)
     h, w = src.shape[:2]
-    area = w * h
-    for _ in range(10):
-        new_area = pyrandom.uniform(min_area, 1.0) * area
-        new_ratio = pyrandom.uniform(*ratio)
-        new_w = int(round(np.sqrt(new_area * new_ratio)))
-        new_h = int(round(np.sqrt(new_area / new_ratio)))
-        if pyrandom.random() < 0.5:
-            new_w, new_h = new_h, new_w
-        if new_w <= w and new_h <= h:
-            x0 = pyrandom.randint(0, w - new_w)
-            y0 = pyrandom.randint(0, h - new_h)
-            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-            return out, (x0, y0, new_w, new_h)
+    for _ in range(attempts):
+        target_area = rng.uniform(min_area, 1.0) * w * h
+        aspect = rng.uniform(*ratio)
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if rng.random() < 0.5:
+            cw, ch = ch, cw
+        if cw <= w and ch <= h:
+            x0 = int(rng.integers(0, w - cw + 1))
+            y0 = int(rng.integers(0, h - ch + 1))
+            return fixed_crop(src, x0, y0, cw, ch, size, interp), \
+                (x0, y0, cw, ch)
     return center_crop(src, size, interp)
 
 
-# -- augmenter functors (reference: image_aug_default.cc chain) -------------
-
-def ResizeAug(size, interp=2):
-    def aug(src):
-        return [resize_short(src, size, interp)]
-
-    return aug
+def color_normalize(src, mean, std=None):
+    out = src.astype(np.float32) - mean
+    return out if std is None else out / std
 
 
-def RandomCropAug(size, interp=2):
-    def aug(src):
-        return [random_crop(src, size, interp)[0]]
-
-    return aug
-
-
-def RandomSizedCropAug(size, min_area, ratio, interp=2):
-    def aug(src):
-        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
-
-    return aug
+# -- augmenters -------------------------------------------------------------
+#
+# An augmenter is a callable (img) -> img carrying its own Generator.  The
+# factory names mirror the reference API; seed= gives reproducibility.
 
 
-def CenterCropAug(size, interp=2):
-    def aug(src):
-        return [center_crop(src, size, interp)[0]]
+class Augmenter:
+    def __init__(self, fn, rng=None):
+        self._fn = fn
+        self.rng = _rng_of(rng)
 
-    return aug
-
-
-def RandomOrderAug(ts):
-    def aug(src):
-        srcs = [src]
-        pyrandom.shuffle(ts)
-        for t in ts:
-            srcs = [j for i in srcs for j in t(i)]
-        return srcs
-
-    return aug
+    def __call__(self, img):
+        return self._fn(img, self.rng)
 
 
-def ColorJitterAug(brightness, contrast, saturation):
-    ts = []
-    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+def ResizeAug(size, interp=2, seed=None):
+    return Augmenter(lambda img, rng: resize_short(img, size, interp),
+                     np.random.default_rng(seed))
+
+
+def RandomCropAug(size, interp=2, seed=None):
+    return Augmenter(
+        lambda img, rng: random_crop(img, size, interp, rng)[0],
+        np.random.default_rng(seed))
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2, seed=None):
+    return Augmenter(
+        lambda img, rng: random_size_crop(img, size, min_area, ratio,
+                                          interp, rng)[0],
+        np.random.default_rng(seed))
+
+
+def CenterCropAug(size, interp=2, seed=None):
+    return Augmenter(lambda img, rng: center_crop(img, size, interp)[0],
+                     np.random.default_rng(seed))
+
+
+def HorizontalFlipAug(p, seed=None):
+    return Augmenter(
+        lambda img, rng: img[:, ::-1] if rng.random() < p else img,
+        np.random.default_rng(seed))
+
+
+def CastAug(seed=None):
+    return Augmenter(lambda img, rng: img.astype(np.float32),
+                     np.random.default_rng(seed))
+
+
+def ColorNormalizeAug(mean, std, seed=None):
+    return Augmenter(lambda img, rng: color_normalize(img, mean, std),
+                     np.random.default_rng(seed))
+
+
+def RandomOrderAug(members, seed=None):
+    """Apply every member augmenter, in a freshly shuffled order per image."""
+    members = list(members)
+
+    def apply(img, rng):
+        order = rng.permutation(len(members))
+        for i in order:
+            img = members[i](img)
+        return img
+
+    return Augmenter(apply, np.random.default_rng(seed))
+
+
+def _jitter(img, alpha, toward):
+    """Blend img toward a target frame: alpha*img + (1-alpha)*toward."""
+    return img * alpha + toward * (1.0 - alpha)
+
+
+def ColorJitterAug(brightness, contrast, saturation, seed=None):
+    """Random brightness/contrast/saturation jitter, shuffled order.
+
+    Each member augmenter gets an independent generator derived from
+    ``seed`` (SeedSequence spawn), so a seeded pipeline is fully
+    reproducible and the three jitters stay uncorrelated.
+    """
+    ss = seed if isinstance(seed, np.random.SeedSequence) \
+        else np.random.SeedSequence(seed)
+    children = iter(ss.spawn(4))
+    members = []
     if brightness > 0:
-        def baug(src):
-            alpha = 1.0 + pyrandom.uniform(-brightness, brightness)
-            return [src * alpha]
-
-        ts.append(baug)
+        def jitter_b(img, rng):
+            return img * (1.0 + rng.uniform(-brightness, brightness))
+        members.append(Augmenter(jitter_b,
+                                 np.random.default_rng(next(children))))
     if contrast > 0:
-        def caug(src):
-            alpha = 1.0 + pyrandom.uniform(-contrast, contrast)
-            gray = src * coef
-            gray = (3.0 * (1.0 - alpha) / gray.size) * np.sum(gray)
-            return [src * alpha + gray]
-
-        ts.append(caug)
+        def jitter_c(img, rng):
+            alpha = 1.0 + rng.uniform(-contrast, contrast)
+            mean_luma = (img * _LUMA).sum() / (img.size / 3)
+            return _jitter(img, alpha, mean_luma)
+        members.append(Augmenter(jitter_c,
+                                 np.random.default_rng(next(children))))
     if saturation > 0:
-        def saug(src):
-            alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
-            gray = np.sum(src * coef, axis=2, keepdims=True)
-            return [src * alpha + gray * (1.0 - alpha)]
-
-        ts.append(saug)
-    return RandomOrderAug(ts)
-
-
-def LightingAug(alphastd, eigval, eigvec):
-    def aug(src):
-        alpha = np.random.normal(0, alphastd, size=(3,))
-        rgb = np.dot(eigvec * alpha, eigval)
-        return [src + rgb]
-
-    return aug
+        def jitter_s(img, rng):
+            alpha = 1.0 + rng.uniform(-saturation, saturation)
+            luma = (img * _LUMA).sum(axis=2, keepdims=True)
+            return _jitter(img, alpha, luma)
+        members.append(Augmenter(jitter_s,
+                                 np.random.default_rng(next(children))))
+    return RandomOrderAug(members, next(children))
 
 
-def ColorNormalizeAug(mean, std):
-    def aug(src):
-        return [color_normalize(src, mean, std)]
+def LightingAug(alphastd, eigval, eigvec, seed=None):
+    """AlexNet-style PCA lighting noise."""
+    def light(img, rng):
+        alpha = rng.normal(0, alphastd, 3)
+        return img + eigvec @ (alpha * eigval)
 
-    return aug
-
-
-def HorizontalFlipAug(p):
-    def aug(src):
-        if pyrandom.random() < p:
-            src = src[:, ::-1]
-        return [src]
-
-    return aug
+    return Augmenter(light, np.random.default_rng(seed))
 
 
-def CastAug():
-    def aug(src):
-        return [src.astype(np.float32)]
-
-    return aug
+# ImageNet RGB PCA basis (AlexNet paper) and torchvision-convention moments
+_IMAGENET_EIGVAL = np.array([55.46, 4.794, 1.148])
+_IMAGENET_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.8140],
+                             [-0.5836, -0.6948, 0.4203]])
+_IMAGENET_MEAN = np.array([123.68, 116.28, 103.53])
+_IMAGENET_STD = np.array([58.395, 57.12, 57.375])
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
-    """Standard augmenter chain (reference: image.py:170)."""
-    auglist = []
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2,
+                    seed=None):
+    """Assemble the standard training/eval chain: resize -> crop -> flip ->
+    cast -> photometric -> normalize.
+
+    Every random augmenter gets its own generator spawned from ``seed``
+    (independent streams; reproducible when seed is set).
+    """
+    spawn = iter(np.random.SeedSequence(seed).spawn(8))
+    chain = []
     if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
-    crop_size = (data_shape[2], data_shape[1])
+        chain.append(ResizeAug(resize, inter_method, next(spawn)))
+    crop = (data_shape[2], data_shape[1])
     if rand_resize:
-        assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
-                                          inter_method))
+        if not rand_crop:
+            raise ValueError("rand_resize requires rand_crop")
+        chain.append(RandomSizedCropAug(crop, 0.3, (3 / 4, 4 / 3),
+                                        inter_method, next(spawn)))
     elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        chain.append(RandomCropAug(crop, inter_method, next(spawn)))
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
+        chain.append(CenterCropAug(crop, inter_method, next(spawn)))
     if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
+        chain.append(HorizontalFlipAug(0.5, next(spawn)))
+    chain.append(CastAug())
     if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+        chain.append(ColorJitterAug(brightness, contrast, saturation,
+                                    next(spawn)))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        chain.append(LightingAug(pca_noise, _IMAGENET_EIGVAL,
+                                 _IMAGENET_EIGVEC, next(spawn)))
     if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
+        mean = _IMAGENET_MEAN
     if std is True:
-        std = np.array([58.395, 57.12, 57.375])
+        std = _IMAGENET_STD
     if mean is not None and getattr(mean, "shape", None):
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+        chain.append(ColorNormalizeAug(mean, std))
+    return chain
+
+
+# -- sample sources ---------------------------------------------------------
+
+
+class _RecordSource:
+    """Samples from a RecordIO file, optionally index-seekable."""
+
+    def __init__(self, path_imgrec, path_imgidx):
+        if path_imgidx:
+            self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                   "r")
+            self.keys = list(self._rec.keys)
+        else:
+            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+            self.keys = None
+
+    def reset(self):
+        self._rec.reset()
+
+    def read(self, key=None):
+        """(label, payload) — by key when index-backed, else sequential."""
+        blob = self._rec.read_idx(key) if key is not None else \
+            self._rec.read()
+        if blob is None:
+            raise StopIteration
+        header, payload = recordio.unpack(blob)
+        return header.label, payload
+
+
+class _ListSource:
+    """Samples named by an image-list (key -> (label, filename))."""
+
+    def __init__(self, entries, path_root):
+        self.table = entries
+        self.keys = list(entries)
+        self.root = path_root or "."
+
+    def reset(self):
+        pass
+
+    def read(self, key):
+        label, fname = self.table[key]
+        with open(os.path.join(self.root, fname), "rb") as f:
+            return label, f.read()
+
+
+def _parse_imglist_file(path):
+    entries = {}
+    with open(path) as f:
+        for line in f:
+            cols = line.strip().split("\t")
+            if not cols or not cols[0]:
+                continue
+            entries[int(cols[0])] = (
+                np.array([float(v) for v in cols[1:-1]], np.float32),
+                cols[-1])
+    return entries
 
 
 class ImageIter(DataIter):
-    """Image iterator over .rec files or image lists (reference: image.py:247)."""
+    """Batched, augmented image iterator over .rec files or image lists.
 
-    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
-                 path_imglist=None, path_root=None, path_imgidx=None,
-                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
-                 imglist=None, data_name="data", label_name="softmax_label",
-                 **kwargs):
+    Combines a sample source, an augmenter chain, and batch assembly; decode
+    failures fall back to the raw-array record codec.  ``seed`` makes the
+    shuffle + augmenter randomness reproducible.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", seed=None, **kwargs):
         super().__init__(batch_size)
-        assert path_imgrec or path_imglist or (isinstance(imglist, list))
-        if path_imgrec:
-            if path_imgidx:
-                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
-                self.imgidx = list(self.imgrec.keys)
-            else:
-                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
-                self.imgidx = None
-        else:
-            self.imgrec = None
+        self._rng = np.random.default_rng(seed)
 
-        self.imglist = None
+        # choose a source; a list/imglist overrides record labels
+        self._labels = None
         if path_imglist:
-            imglist = {}
-            imgkeys = []
-            with open(path_imglist) as fin:
-                for line in fin.readlines():
-                    line = line.strip().split("\t")
-                    label = np.array([float(i) for i in line[1:-1]], dtype=np.float32)
-                    key = int(line[0])
-                    imglist[key] = (label, line[-1])
-                    imgkeys.append(key)
-            self.imglist = imglist
-            self.seq = imgkeys
+            self._labels = _parse_imglist_file(path_imglist)
         elif isinstance(imglist, list):
-            result = {}
-            imgkeys = []
-            index = 1
-            for img in imglist:
-                key = str(index)
-                index += 1
-                result[key] = (np.array(img[:-1], dtype=np.float32), img[-1])
-                imgkeys.append(str(key))
-            self.imglist = result
-            self.seq = imgkeys
+            self._labels = {i + 1: (np.asarray(row[:-1], np.float32),
+                                    row[-1])
+                            for i, row in enumerate(imglist)}
+        if path_imgrec:
+            if self._labels and not path_imgidx:
+                raise MXNetError(
+                    "an external label list over a record file needs "
+                    "path_imgidx (records must be fetched by key)")
+            self._source = _RecordSource(path_imgrec, path_imgidx)
+            self._order = list(self._labels) if self._labels else \
+                self._source.keys
+        elif self._labels:
+            self._source = _ListSource(self._labels, path_root)
+            self._order = self._source.keys
         else:
-            self.seq = self.imgidx
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist, or "
+                             "imglist")
 
-        self.path_root = path_root
-        self.provide_data = [DataDesc(data_name, (batch_size,) + tuple(data_shape))]
-        if label_width > 1:
-            self.provide_label = [DataDesc(label_name, (batch_size, label_width))]
-        else:
-            self.provide_label = [DataDesc(label_name, (batch_size,))]
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
-        if num_parts > 1 and self.seq is not None:
-            part = len(self.seq) // num_parts
-            self.seq = self.seq[part_index * part:(part_index + 1) * part]
+        if num_parts > 1 and self._order is not None:
+            span = len(self._order) // num_parts
+            self._order = self._order[part_index * span:
+                                      (part_index + 1) * span]
+
         if aug_list is None:
-            self.auglist = CreateAugmenter(data_shape, **{
-                k: v for k, v in kwargs.items()
-                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
-                         "mean", "std", "brightness", "contrast", "saturation",
-                         "pca_noise", "inter_method")})
-        else:
-            self.auglist = aug_list
-        self.cur = 0
+            aug_keys = ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                        "mean", "std", "brightness", "contrast",
+                        "saturation", "pca_noise", "inter_method")
+            aug_list = CreateAugmenter(
+                data_shape, seed=seed,
+                **{k: v for k, v in kwargs.items() if k in aug_keys})
+        self.auglist = aug_list
+
+        label_shape = (batch_size, label_width) if label_width > 1 \
+            else (batch_size,)
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name, label_shape)]
+        self._cursor = 0
         self.reset()
 
     def reset(self):
-        if self.shuffle and self.seq is not None:
-            pyrandom.shuffle(self.seq)
-        if self.imgrec is not None:
-            self.imgrec.reset()
-        self.cur = 0
+        self._cursor = 0
+        self._source.reset()
+        if self.shuffle and self._order is not None:
+            self._rng.shuffle(self._order)
 
+    # -- sample stream -----------------------------------------------------
     def next_sample(self):
-        if self.seq is not None:
-            if self.cur >= len(self.seq):
+        """(label, decoded HWC image) for the next sample."""
+        if self._order is not None:
+            if self._cursor >= len(self._order):
                 raise StopIteration
-            idx = self.seq[self.cur]
-            self.cur += 1
-            if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
-                header, img = recordio.unpack(s)
-                if self.imglist is None:
-                    return header.label, img
-                return self.imglist[idx][0], img
-            label, fname = self.imglist[idx]
-            with open(os.path.join(self.path_root, fname), "rb") as fin:
-                img = fin.read()
-            return label, img
+            key = self._order[self._cursor]
+            self._cursor += 1
+            label, payload = self._source.read(key)
+            if self._labels is not None:
+                label = self._labels[key][0]
         else:
-            s = self.imgrec.read()
-            if s is None:
-                raise StopIteration
-            header, img = recordio.unpack(s)
-            return header.label, img
+            label, payload = self._source.read()
+        return label, self._decode(payload, label)
 
-    def next(self):
-        batch_size = self.batch_size
-        c, h, w = self.data_shape
-        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
-        batch_label = np.zeros((batch_size,) if self.label_width == 1
-                               else (batch_size, self.label_width),
-                               dtype=np.float32)
-        i = 0
+    def _decode(self, payload, label):
+        if not isinstance(payload, bytes):
+            return payload
         try:
-            while i < batch_size:
-                label, s = self.next_sample()
-                if isinstance(s, bytes):
-                    try:
-                        data = [imdecode(s)]
-                    except MXNetError:
-                        _, data_arr = recordio.unpack_img(
-                            recordio.pack(recordio.IRHeader(0, label, 0, 0), s))
-                        data = [data_arr]
-                else:
-                    data = [s]
-                if data[0].ndim == 2:
-                    data = [np.broadcast_to(d[:, :, None], d.shape + (c,))
-                            for d in data]
+            return imdecode(payload)
+        except MXNetError:
+            _, arr = recordio.unpack_img(
+                recordio.pack(recordio.IRHeader(0, label, 0, 0), payload))
+            return arr
+
+    # -- batching ----------------------------------------------------------
+    def next(self):
+        c, h, w = self.data_shape
+        images = np.zeros((self.batch_size, h, w, c), np.float32)
+        label_shape = self.provide_label[0].shape
+        labels = np.zeros(label_shape, np.float32)
+        filled = 0
+        try:
+            while filled < self.batch_size:
+                label, img = self.next_sample()
+                if img.ndim == 2:
+                    img = np.repeat(img[:, :, None], c, axis=2)
                 for aug in self.auglist:
-                    data = [ret for src in data for ret in aug(src)]
-                for d in data:
-                    if i >= batch_size:
-                        break
-                    if d.shape[:2] != (h, w):
-                        d = _resize(d.astype(np.float32), w, h)
-                    batch_data[i] = d
-                    batch_label[i] = label
-                    i += 1
+                    img = aug(img)
+                if img.shape[:2] != (h, w):
+                    img = _resize(img.astype(np.float32), w, h)
+                images[filled] = img
+                labels[filled] = label
+                filled += 1
         except StopIteration:
-            if i == 0:
+            if filled == 0:
                 raise
-        # HWC -> CHW
-        batch_data = np.transpose(batch_data, (0, 3, 1, 2))
-        return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
-                         pad=batch_size - i)
+        return DataBatch([nd.array(images.transpose(0, 3, 1, 2))],
+                         [nd.array(labels)],
+                         pad=self.batch_size - filled)
 
 
 def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
@@ -413,21 +496,19 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
                     std_r=1, std_g=1, std_b=1, rand_crop=False,
                     rand_mirror=False, preprocess_threads=4, num_parts=1,
                     part_index=0, path_imgidx=None, prefetch_buffer=4,
-                    **kwargs):
-    """RecordIO image iterator (reference: iter_image_recordio.cc), assembled
-    from ImageIter + PrefetchingIter (threaded decode analog)."""
-    mean = None
-    if mean_r or mean_g or mean_b:
-        mean = np.array([mean_r, mean_g, mean_b])
-    std = None
-    if (std_r, std_g, std_b) != (1, 1, 1):
-        std = np.array([std_r, std_g, std_b])
-    aug_kwargs = {k: v for k, v in kwargs.items()
-                  if k in ("resize", "rand_resize", "brightness", "contrast",
-                           "saturation", "pca_noise", "inter_method")}
-    it = ImageIter(batch_size=batch_size, data_shape=data_shape,
-                   path_imgrec=path_imgrec, path_imgidx=path_imgidx,
-                   shuffle=shuffle, rand_crop=rand_crop, rand_mirror=rand_mirror,
-                   mean=mean, std=std, num_parts=num_parts,
-                   part_index=part_index, **aug_kwargs)
-    return io_mod.PrefetchingIter(it, capacity=prefetch_buffer)
+                    seed=None, **kwargs):
+    """RecordIO image pipeline (C++ ``ImageRecordIter`` analog): ImageIter
+    decode+augment wrapped in a prefetch thread double-buffer."""
+    mean = np.array([mean_r, mean_g, mean_b]) \
+        if (mean_r or mean_g or mean_b) else None
+    std = np.array([std_r, std_g, std_b]) \
+        if (std_r, std_g, std_b) != (1, 1, 1) else None
+    passthrough = ("resize", "rand_resize", "brightness", "contrast",
+                   "saturation", "pca_noise", "inter_method")
+    inner = ImageIter(batch_size=batch_size, data_shape=data_shape,
+                      path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                      shuffle=shuffle, rand_crop=rand_crop,
+                      rand_mirror=rand_mirror, mean=mean, std=std,
+                      num_parts=num_parts, part_index=part_index, seed=seed,
+                      **{k: v for k, v in kwargs.items() if k in passthrough})
+    return io_mod.PrefetchingIter(inner, capacity=prefetch_buffer)

@@ -1,20 +1,24 @@
-"""Weight initializers (reference: python/mxnet/initializer.py, 612 LoC).
+"""Weight initializers.
 
-Dispatch by parameter-name suffix exactly as the reference does: *_bias → 0,
-*_gamma → 1, *_beta → 0, *moving_mean → 0, *moving_var → 1, *weight → the
-chosen scheme.
+API parity with the reference's ``python/mxnet/initializer.py`` (same class
+names, same name-suffix dispatch contract), rebuilt around a functional
+core: every initializer produces its values via ``generate(name, shape)``
+and a single assignment point writes them into the target buffer.  Role
+detection is a data table, not an if-chain, so subclasses and tests can
+inspect/extend it.
 """
 from __future__ import annotations
 
 import json
+import re
 
 import numpy as np
 
 from .base import MXNetError
 
 __all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier",
-           "MSRAPrelu", "Bilinear", "One", "Zero", "Constant", "Load", "Mixed",
-           "LSTMBias", "FusedRNN", "init_registry"]
+           "MSRAPrelu", "Bilinear", "One", "Zero", "Constant", "Load",
+           "Mixed", "LSTMBias", "FusedRNN", "init_registry"]
 
 init_registry = {}
 
@@ -24,109 +28,216 @@ def register(klass):
     return klass
 
 
+def create(spec):
+    """Instantiate an initializer from its ``dumps()`` JSON form."""
+    klass, kwargs = json.loads(spec)
+    return init_registry[klass.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """A parameter name plus its Variable attributes.
+
+    ``Module.init_params`` passes these so a per-Variable ``__init__`` attr
+    (e.g. ``Variable(..., init=LSTMBias(1.0))``) overrides the global
+    initializer for that parameter.
+    """
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        desc = super().__new__(cls, name)
+        desc.attrs = attrs or {}
+        desc.global_init = global_init
+        return desc
+
+
+def _bilinear_kernel(shape):
+    """Bilinear-interpolation upsampling kernel of the given (..., H, W)
+    shape, vectorized over the spatial grid."""
+    h, w = shape[-2], shape[-1]
+    f = np.ceil(w / 2.0)
+    center = (2 * f - 1 - f % 2) / (2.0 * f)
+    ys, xs = np.ogrid[:h, :w]
+    tap = (1 - np.abs(xs / f - center)) * (1 - np.abs(ys / f - center))
+    return np.broadcast_to(tap, shape).astype(np.float32)
+
+
+# suffix -> method name; longest suffix wins (checked in order), mirroring
+# the reference's dispatch contract for BatchNorm/bias/weight param names
+_ROLE_RULES = (
+    ("moving_inv_var", "_init_zero"),
+    ("moving_mean", "_init_zero"),
+    ("moving_var", "_init_one"),
+    ("moving_avg", "_init_zero"),
+    ("weight", "_init_weight"),
+    ("gamma", "_init_gamma"),
+    ("beta", "_init_beta"),
+    ("bias", "_init_bias"),
+)
+
+
 class Initializer:
-    """Base initializer: name-pattern dispatch (reference: initializer.py:20)."""
+    """Base class: routes a parameter to its role-specific rule.
+
+    Subclasses typically override only ``generate`` (values for *weight*
+    parameters); biases/BatchNorm statistics get their conventional
+    constants regardless of scheme.
+    """
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
 
     def dumps(self):
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        """Serialized form consumed by FusedRNN(init=<str>)."""
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
+    # -- dispatch ----------------------------------------------------------
     def __call__(self, name, arr):
-        if not isinstance(name, str):
-            name = str(name)
-        if name.startswith("upsampling"):
-            self._init_bilinear(name, arr)
-        elif name.endswith("bias"):
-            self._init_bias(name, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(name, arr)
-        elif name.endswith("beta"):
-            self._init_beta(name, arr)
-        elif name.endswith("weight"):
-            self._init_weight(name, arr)
-        elif name.endswith("moving_mean"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_var"):
-            self._init_one(name, arr)
-        elif name.endswith("moving_inv_var"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_avg"):
-            self._init_zero(name, arr)
-        else:
-            self._init_default(name, arr)
+        # a Variable-attached init (InitDesc attrs) takes precedence over
+        # this (global) initializer, whatever the name suffix
+        spec = getattr(name, "attrs", {}).get("__init__")
+        if spec:
+            create(spec)._init_weight(name, arr)
+            return
+        name_s = str(name)
+        if name_s.startswith("upsampling"):
+            arr[:] = _bilinear_kernel(arr.shape)
+            return
+        for suffix, method in _ROLE_RULES:
+            if name_s.endswith(suffix):
+                getattr(self, method)(name, arr)
+                return
+        self._init_default(name, arr)
 
-    def _init_bilinear(self, _, arr):
-        weight = np.zeros(arr.size, dtype=np.float32)
-        shape = arr.shape
-        f = np.ceil(shape[3] / 2.0)
-        c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(arr.size):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        arr[:] = weight.reshape(shape)
-
+    # -- role rules (constants unless overridden) --------------------------
     def _init_zero(self, _, arr):
         arr[:] = 0.0
 
     def _init_one(self, _, arr):
         arr[:] = 1.0
 
-    def _init_bias(self, _, arr):
-        arr[:] = 0.0
-
-    def _init_gamma(self, _, arr):
-        arr[:] = 1.0
-
-    def _init_beta(self, _, arr):
-        arr[:] = 0.0
+    _init_bias = _init_zero
+    _init_beta = _init_zero
+    _init_gamma = _init_one
 
     def _init_weight(self, name, arr):
-        raise NotImplementedError("Must override _init_weight")
+        arr[:] = self.generate(name, arr.shape)
+
+    def generate(self, name, shape):
+        """Return a numpy array of weight values for ``shape``."""
+        raise NotImplementedError(
+            "%s must implement generate()" % type(self).__name__)
 
     def _init_default(self, name, arr):
         raise MXNetError(
-            "Unknown initialization pattern for %s. Default initialization is now "
-            "limited to weight/bias/gamma/beta; use mx.sym.Variable(init=...) to "
-            "set initialization pattern" % name)
+            "No initialization rule matches parameter %r; recognized "
+            "suffixes: %s (or attach an init attr to the Variable)"
+            % (name, ", ".join(s for s, _ in _ROLE_RULES)))
+
+
+# -- random schemes ---------------------------------------------------------
 
 
 @register
 class Uniform(Initializer):
+    """U(-scale, scale)."""
+
     def __init__(self, scale=0.07):
         super().__init__(scale=scale)
         self.scale = scale
 
-    def _init_weight(self, _, arr):
-        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+    def generate(self, name, shape):
+        return np.random.uniform(-self.scale, self.scale, shape)
 
 
 @register
 class Normal(Initializer):
+    """N(0, sigma^2)."""
+
     def __init__(self, sigma=0.01):
         super().__init__(sigma=sigma)
         self.sigma = sigma
 
-    def _init_weight(self, _, arr):
-        arr[:] = np.random.normal(0, self.sigma, arr.shape)
+    def generate(self, name, shape):
+        return np.random.normal(0.0, self.sigma, shape)
 
 
 @register
-class One(Initializer):
-    def _init_weight(self, _, arr):
-        arr[:] = 1.0
+class Orthogonal(Initializer):
+    """Scaled orthogonal rows/columns (Saxe et al. 2013), via QR with sign
+    correction rather than SVD."""
 
-    _init_default = _init_weight
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def generate(self, name, shape):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        if self.rand_type == "uniform":
+            seed = np.random.uniform(-1.0, 1.0, (max(rows, cols),
+                                                 min(rows, cols)))
+        elif self.rand_type == "normal":
+            seed = np.random.standard_normal((max(rows, cols),
+                                              min(rows, cols)))
+        else:
+            raise ValueError("rand_type must be 'uniform' or 'normal'")
+        q, r = np.linalg.qr(seed)
+        # make the factorization unique (and q's distribution uniform over
+        # the orthogonal group) by fixing the signs of r's diagonal
+        q *= np.sign(np.diag(r))
+        if rows < cols:
+            q = q.T
+        return (self.scale * q).reshape(shape)
+
+
+def _fan_in_out(shape):
+    """(fan_in, fan_out) with conv receptive-field scaling: dims beyond the
+    first two multiply both fans."""
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    return shape[1] * receptive, shape[0] * receptive
 
 
 @register
-class Zero(Initializer):
-    def _init_weight(self, _, arr):
-        arr[:] = 0.0
+class Xavier(Initializer):
+    """Glorot-style variance scaling."""
 
-    _init_default = _init_weight
+    _FACTORS = {
+        "avg": lambda fi, fo: (fi + fo) / 2.0,
+        "in": lambda fi, fo: fi,
+        "out": lambda fi, fo: fo,
+    }
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        if factor_type not in self._FACTORS:
+            raise ValueError("factor_type must be one of %s"
+                             % sorted(self._FACTORS))
+        if rnd_type not in ("uniform", "gaussian"):
+            raise ValueError("rnd_type must be 'uniform' or 'gaussian'")
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def generate(self, name, shape):
+        fan_in, fan_out = _fan_in_out(shape)
+        bound = np.sqrt(self.magnitude
+                        / self._FACTORS[self.factor_type](fan_in, fan_out))
+        if self.rnd_type == "uniform":
+            return np.random.uniform(-bound, bound, shape)
+        return np.random.normal(0.0, bound, shape)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He/Kaiming init adjusted for PReLU slope."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope ** 2))
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+# -- constant schemes -------------------------------------------------------
 
 
 @register
@@ -135,174 +246,151 @@ class Constant(Initializer):
         super().__init__(value=value)
         self.value = value
 
-    def _init_weight(self, _, arr):
+    def generate(self, name, shape):
+        return np.full(shape, self.value, np.float32)
+
+    def _init_default(self, name, arr):
         arr[:] = self.value
 
-    _init_default = _init_weight
+
+@register
+class One(Constant):
+    def __init__(self):
+        super().__init__(1.0)
+        self._kwargs = {}
 
 
 @register
-class Orthogonal(Initializer):
-    """Orthogonal matrix init (reference: initializer.py:177, Saxe et al.)."""
-
-    def __init__(self, scale=1.414, rand_type="uniform"):
-        super().__init__(scale=scale, rand_type=rand_type)
-        self.scale = scale
-        self.rand_type = rand_type
-
-    def _init_weight(self, _, arr):
-        nout = arr.shape[0]
-        nin = int(np.prod(arr.shape[1:]))
-        if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
-        else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
-        u, _, v = np.linalg.svd(tmp, full_matrices=False)
-        q = u if u.shape == tmp.shape else v
-        arr[:] = (self.scale * q).reshape(arr.shape)
-
-
-@register
-class Xavier(Initializer):
-    """Xavier/Glorot (reference: initializer.py:203)."""
-
-    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
-        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
-                         magnitude=magnitude)
-        self.rnd_type = rnd_type
-        self.factor_type = factor_type
-        self.magnitude = float(magnitude)
-
-    def _init_weight(self, _, arr):
-        shape = arr.shape
-        hw_scale = 1.0
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
-            raise ValueError("Incorrect factor type")
-        scale = np.sqrt(self.magnitude / factor)
-        if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-scale, scale, shape)
-        elif self.rnd_type == "gaussian":
-            arr[:] = np.random.normal(0, scale, shape)
-        else:
-            raise ValueError("Unknown random type")
-
-
-@register
-class MSRAPrelu(Xavier):
-    """Kaiming init (reference: initializer.py:239)."""
-
-    def __init__(self, factor_type="avg", slope=0.25):
-        magnitude = 2.0 / (1 + slope ** 2)
-        super().__init__("gaussian", factor_type, magnitude)
-        self._kwargs = {"factor_type": factor_type, "slope": slope}
+class Zero(Constant):
+    def __init__(self):
+        super().__init__(0.0)
+        self._kwargs = {}
 
 
 @register
 class Bilinear(Initializer):
-    def _init_weight(self, name, arr):
-        self._init_bilinear(name, arr)
+    def generate(self, name, shape):
+        return _bilinear_kernel(shape)
+
+
+# -- composite / data-driven schemes ----------------------------------------
 
 
 @register
 class Load:
-    """Init from a dict of saved arrays (reference: initializer.py:86)."""
+    """Serve values from a loaded ``{name: array}`` dict, falling back to
+    ``default_init`` for names not present."""
 
     def __init__(self, param, default_init=None, verbose=False):
-        self.param = {k[4:] if k.startswith("arg:") or k.startswith("aux:") else k: v
-                      for k, v in param.items()}
+        self.param = {}
+        for key, value in param.items():
+            bare = key.split(":", 1)[1] if key[:4] in ("arg:", "aux:") \
+                else key
+            self.param[bare] = value
         self.default_init = default_init
         self.verbose = verbose
 
     def __call__(self, name, arr):
-        if name in self.param:
-            if self.param[name].shape != arr.shape:
-                raise MXNetError("Parameter %s shape mismatch: %s vs %s"
-                                 % (name, self.param[name].shape, arr.shape))
-            arr[:] = self.param[name]
-        else:
-            if self.default_init is None:
-                raise MXNetError("Cannot init %s: not in loaded param and no "
-                                 "default_init" % name)
+        source = self.param.get(name)
+        if source is not None:
+            if tuple(source.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    "Loaded parameter %r has shape %s, expected %s"
+                    % (name, tuple(source.shape), tuple(arr.shape)))
+            arr[:] = source
+        elif self.default_init is not None:
             self.default_init(name, arr)
+        else:
+            raise MXNetError("Parameter %r is not in the loaded dict and no "
+                             "default_init was given" % name)
 
 
 @register
 class Mixed:
-    """Pattern-matched mix of initializers (reference: initializer.py:115)."""
+    """First-match-wins regex routing to member initializers."""
 
     def __init__(self, patterns, initializers):
-        import re
-
-        assert len(patterns) == len(initializers)
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
 
     def __call__(self, name, arr):
-        for prog, init in self.map:
-            if prog.match(name):
+        for matcher, init in self.map:
+            if matcher.match(name):
                 init(name, arr)
                 return
-        raise MXNetError("Parameter %s did not match any pattern" % name)
+        raise MXNetError("Parameter %r matched no pattern (have: %s)"
+                         % (name, [m.pattern for m, _ in self.map]))
+
+
+# -- RNN-specific schemes ---------------------------------------------------
+
+
+def _lstm_bias(shape, forget_bias):
+    """Zero bias with the forget gate (second quarter, i/f/c/o gate order)
+    set to ``forget_bias``."""
+    bias = np.zeros(shape, np.float32)
+    nh = shape[0] // 4
+    bias[nh:2 * nh] = forget_bias
+    return bias
 
 
 @register
 class LSTMBias(Initializer):
-    """Init LSTM biases with custom forget-gate bias (reference: :260)."""
+    """LSTM bias init with a configurable forget-gate bias (combats early
+    vanishing gradients)."""
 
     def __init__(self, forget_bias=1.0):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
-    def _init_bias(self, name, arr):
-        b = np.zeros(arr.shape, dtype=np.float32)
-        num_hidden = arr.shape[0] // 4
-        b[num_hidden:2 * num_hidden] = self.forget_bias  # i, f, c, o gate order
-        arr[:] = b
+    def generate(self, name, shape):
+        return _lstm_bias(shape, self.forget_bias)
+
+    # attr-dispatch enters through _init_weight whatever the target is
+    _init_bias = Initializer._init_weight
 
 
 @register
 class FusedRNN(Initializer):
-    """Init fused RNN packed parameters (reference: initializer.py:285)."""
+    """Initialize a FusedRNNCell's packed parameter blob by unpacking it,
+    running an inner initializer per logical weight/bias, and re-packing."""
 
-    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
-                 forget_bias=1.0):
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
         if isinstance(init, str):
             klass, kwargs = json.loads(init)
             init = init_registry[klass.lower()](**kwargs)
         super().__init__(init=init.dumps() if init is not None else None,
-                         num_hidden=num_hidden, num_layers=num_layers, mode=mode,
-                         bidirectional=bidirectional, forget_bias=forget_bias)
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
         self._init = init
-        self._num_hidden = num_hidden
-        self._num_layers = num_layers
-        self._mode = mode
-        self._bidirectional = bidirectional
-        self._forget_bias = forget_bias
+        self._spec = dict(num_hidden=num_hidden, num_layers=num_layers,
+                          mode=mode, bidirectional=bidirectional,
+                          forget_bias=forget_bias)
 
     def _init_weight(self, name, arr):
         from .rnn.rnn_cell import FusedRNNCell
 
-        cell = FusedRNNCell(self._num_hidden, self._num_layers, self._mode,
-                            self._bidirectional, forget_bias=self._forget_bias)
-        args = cell.unpack_weights({"parameters": arr.copy()})
-        for pname, value in args.items():
-            desc = pname
-            if self._init is None:
-                raise MXNetError("FusedRNN requires an inner init")
-            if pname.endswith("bias") and self._forget_bias is not None and \
-                    self._mode == "lstm":
-                value[:] = 0.0
-                nh = self._num_hidden
-                value[nh:2 * nh] = self._forget_bias
+        inner = self._init or getattr(name, "global_init", None)
+        if inner is None:
+            raise MXNetError("FusedRNN needs an inner initializer (or a "
+                             "global one via InitDesc) for its weights")
+        spec = self._spec
+        # bare prefix: this scratch cell only translates layout, and the
+        # pieces dict below is keyed without the owning cell's prefix
+        cell = FusedRNNCell(spec["num_hidden"], spec["num_layers"],
+                            spec["mode"], spec["bidirectional"],
+                            forget_bias=spec["forget_bias"], prefix="")
+        pieces = cell.unpack_weights({"parameters": arr.copy()})
+        for pname, piece in pieces.items():
+            if spec["mode"] == "lstm" and pname.endswith("bias"):
+                piece[:] = _lstm_bias(piece.shape, spec["forget_bias"])
             else:
-                self._init(desc, value)
-            args[pname] = value
-        arr[:] = cell.pack_weights(args)["parameters"]
+                inner(pname, piece)
+        arr[:] = cell.pack_weights(pieces)["parameters"]
+
+    # '<prefix>parameters' has no role suffix; direct calls route here too
+    _init_default = _init_weight

@@ -149,7 +149,14 @@ class Module(BaseModule):
                                dtype=self._exec_group.exec_.aux_dict[name].dtype)
                 for name in aux_names}
 
+        from ..initializer import InitDesc
+
+        # Variable attrs make per-param init overrides visible to the
+        # initializer (reference: initializer.py:85-107 InitDesc dispatch)
+        attrs = self._symbol.attr_dict()
+
         def _impl(name, arr, cache):
+            desc = InitDesc(name, attrs.get(name), initializer)
             if cache is not None:
                 if name in cache:
                     cache_arr = cache[name]
@@ -164,10 +171,10 @@ class Module(BaseModule):
                     if not allow_missing:
                         raise RuntimeError("%s is not presented" % name)
                     if initializer is not None:
-                        initializer(name, arr)
+                        initializer(desc, arr)
             else:
                 if initializer is not None:
-                    initializer(name, arr)
+                    initializer(desc, arr)
 
         for name, arr in sorted(self._arg_params.items()):
             _impl(name, arr, arg_params)

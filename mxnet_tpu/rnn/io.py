@@ -1,8 +1,16 @@
-"""Bucketing data iterator for sequences (reference: python/mxnet/rnn/io.py)."""
+"""Bucketing data iterator for sequences.
+
+Capability parity with the reference's ``BucketSentenceIter``
+(``python/mxnet/rnn/io.py``), re-designed for array-at-once construction:
+instead of binning sentences one by one into Python lists, all lengths are
+bucketed in a single ``np.searchsorted`` and the padded token matrix is
+materialized with one boolean-mask assignment.  On TPU each bucket length is
+a distinct XLA compilation, so the bucket inventory doubles as the jit-cache
+key set (see BucketingModule).
+"""
 from __future__ import annotations
 
-import bisect
-import random
+import logging
 
 import numpy as np
 
@@ -12,94 +20,120 @@ from .. import ndarray as nd
 __all__ = ["BucketSentenceIter"]
 
 
-class BucketSentenceIter(DataIter):
-    """Bucketed sentence iterator for LMs (reference: rnn/io.py:12).
+def _auto_buckets(lengths, batch_size):
+    """Pick bucket lengths: every distinct sentence length that occurs often
+    enough to fill at least one batch becomes a bucket."""
+    uniq, counts = np.unique(lengths, return_counts=True)
+    chosen = uniq[counts >= batch_size].tolist()
+    if not chosen:
+        chosen = [int(uniq.max())]
+    return chosen
 
-    `sentences` is a list of int-id lists; they are binned into the smallest
-    bucket that fits, padded with `invalid_label`.
+
+def _pad_matrix(sentences, lengths, width, fill, dtype):
+    """All sentences as one (n, width) matrix, tail-padded with ``fill``."""
+    out = np.full((len(sentences), width), fill, dtype=dtype)
+    mask = np.arange(width)[None, :] < lengths[:, None]
+    out[mask] = np.concatenate([np.asarray(s, dtype=dtype)
+                                for s in sentences]) if sentences else []
+    return out
+
+
+class BucketSentenceIter(DataIter):
+    """Language-model iterator over variable-length token-id sequences.
+
+    Sequences are assigned to the smallest bucket that fits (longer ones are
+    dropped with a warning), padded with ``invalid_label``, and served as
+    full batches whose ``bucket_key`` selects the matching unrolled graph.
+    Labels are the inputs shifted one step left (next-token prediction).
+
+    ``layout``: "NT" serves (batch, time); "TN" serves (time, batch).
     """
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
                  data_name="data", label_name="softmax_label", dtype="float32",
-                 layout="NT"):
+                 layout="NT", seed=None):
         super().__init__(batch_size)
-        if not buckets:
-            buckets = [i for i, j in enumerate(np.bincount([len(s) for s in sentences]))
-                       if j >= batch_size]
-        buckets.sort()
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sentence in sentences:
-            buck = bisect.bisect_left(buckets, len(sentence))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sentence)] = sentence
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
-        if ndiscard:
-            print("WARNING: discarded %d sentences longer than the largest bucket."
-                  % ndiscard)
+        lengths = np.array([len(s) for s in sentences], dtype=np.int64)
+        buckets = sorted(buckets) if buckets else _auto_buckets(lengths,
+                                                                batch_size)
 
+        # vectorized binning: smallest bucket >= length, out-of-range -> drop
+        which = np.searchsorted(buckets, lengths, side="left")
+        keep = which < len(buckets)
+        if not keep.all():
+            logging.warning(
+                "BucketSentenceIter: dropping %d sequence(s) longer than the "
+                "largest bucket (%d)", int((~keep).sum()), buckets[-1])
+
+        self.buckets = list(buckets)
         self.batch_size = batch_size
-        self.buckets = buckets
+        self.invalid_label = invalid_label
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
-        self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
-        self.major_axis = layout.find("N")
         self.default_bucket_key = max(buckets)
 
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(data_name,
-                                          (batch_size, self.default_bucket_key))]
-            self.provide_label = [DataDesc(label_name,
-                                           (batch_size, self.default_bucket_key))]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(data_name,
-                                          (self.default_bucket_key, batch_size))]
-            self.provide_label = [DataDesc(label_name,
-                                           (self.default_bucket_key, batch_size))]
+        if layout == "NT":
+            self._batch_major = True
+        elif layout == "TN":
+            self._batch_major = False
         else:
-            raise ValueError("Invalid layout %s: Must by NT (batch major) or TN "
-                             "(time major)" % layout)
+            raise ValueError("layout must be 'NT' (batch major) or 'TN' "
+                             "(time major), got %r" % layout)
 
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in range(0, len(buck) - batch_size + 1,
-                                                   batch_size)])
-        self.curr_idx = 0
+        # one padded matrix per bucket, built in bulk
+        self._tokens = []
+        for b, width in enumerate(buckets):
+            rows = np.nonzero(keep & (which == b))[0]
+            group = [sentences[i] for i in rows]
+            self._tokens.append(
+                _pad_matrix(group, lengths[rows], width, invalid_label,
+                            dtype))
+
+        self._order = None      # per-bucket row permutations
+        self._schedule = None   # shuffled (bucket, row-window) pairs
+        self._cursor = 0
+        self._rng = np.random.RandomState(seed)  # seed pins epoch order
         self.reset()
 
+        shape = ((batch_size, self.default_bucket_key) if self._batch_major
+                 else (self.default_bucket_key, batch_size))
+        self.provide_data = [DataDesc(data_name, shape)]
+        self.provide_label = [DataDesc(label_name, shape)]
+
+    # -- epoch machinery -----------------------------------------------------
     def reset(self):
-        self.curr_idx = 0
-        random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-        self.nddata = []
-        self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(nd.array(buck, dtype=self.dtype))
-            self.ndlabel.append(nd.array(label, dtype=self.dtype))
+        self._cursor = 0
+        self._order = [self._rng.permutation(len(t)) for t in self._tokens]
+        schedule = [(b, start)
+                    for b, tokens in enumerate(self._tokens)
+                    for start in range(0,
+                                       len(tokens) - self.batch_size + 1,
+                                       self.batch_size)]
+        self._rng.shuffle(schedule)
+        self._schedule = schedule
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self._cursor >= len(self._schedule):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
-        self.curr_idx += 1
-        if self.major_axis == 1:
-            data = self.nddata[i][j:j + self.batch_size].T
-            label = self.ndlabel[i][j:j + self.batch_size].T
-        else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
+        b, start = self._schedule[self._cursor]
+        self._cursor += 1
+
+        rows = self._order[b][start:start + self.batch_size]
+        tokens = self._tokens[b][rows]
+        # next-token labels: shift left, pad the final step
+        labels = np.concatenate(
+            [tokens[:, 1:],
+             np.full((len(tokens), 1), self.invalid_label,
+                     dtype=tokens.dtype)], axis=1)
+        if not self._batch_major:
+            tokens = tokens.T
+            labels = labels.T
+        data = nd.array(tokens, dtype=self.dtype)
+        label = nd.array(labels, dtype=self.dtype)
         return DataBatch([data], [label], pad=0,
-                         bucket_key=self.buckets[i],
+                         bucket_key=self.buckets[b],
                          provide_data=[DataDesc(self.data_name, data.shape)],
-                         provide_label=[DataDesc(self.label_name, label.shape)])
+                         provide_label=[DataDesc(self.label_name,
+                                                 label.shape)])

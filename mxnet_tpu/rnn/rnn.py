@@ -1,4 +1,12 @@
-"""RNN checkpoint helpers (reference: python/mxnet/rnn/rnn.py)."""
+"""RNN-aware checkpointing.
+
+Fused RNN cells keep their parameters as one packed device blob; on disk we
+want the individual per-gate weights so checkpoints are portable between
+fused and unfused graphs.  These helpers wrap the generic model checkpoint
+path (``model.save_checkpoint``/``load_checkpoint``) with a pack step on
+save and an unpack step on load.  Capability parity:
+``python/mxnet/rnn/rnn.py``.
+"""
 from __future__ import annotations
 
 from .. import model as model_mod
@@ -6,33 +14,37 @@ from .. import model as model_mod
 __all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]
 
 
-def _as_list(cells):
-    return cells if isinstance(cells, (list, tuple)) else [cells]
+def _through_cells(cells, method, params):
+    """Thread ``params`` through ``cell.<method>`` for every cell."""
+    if not isinstance(cells, (list, tuple)):
+        cells = (cells,)
+    for cell in cells:
+        params = getattr(cell, method)(params)
+    return params
 
 
 def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
-    """Save checkpoint with fused weights packed (reference: rnn.py:10)."""
-    cells = _as_list(cells)
-    for cell in cells:
-        arg_params = cell.pack_weights(arg_params)
-    model_mod.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+    """``model.save_checkpoint`` with fused-cell weights packed first."""
+    model_mod.save_checkpoint(
+        prefix, epoch, symbol,
+        _through_cells(cells, "pack_weights", arg_params), aux_params)
 
 
 def load_rnn_checkpoint(cells, prefix, epoch):
-    """Load checkpoint, unpacking fused weights (reference: rnn.py:35)."""
-    sym, arg, aux = model_mod.load_checkpoint(prefix, epoch)
-    cells = _as_list(cells)
-    for cell in cells:
-        arg = cell.unpack_weights(arg)
-    return sym, arg, aux
+    """``model.load_checkpoint`` + unpack of fused-cell weights."""
+    symbol, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
+    return symbol, _through_cells(cells, "unpack_weights", arg_params), \
+        aux_params
 
 
 def do_rnn_checkpoint(cells, prefix, period=1):
-    """Epoch-end callback (reference: rnn.py:61)."""
-    period = int(max(1, period))
+    """Epoch-end callback variant of ``save_rnn_checkpoint`` (drop-in for
+    ``callback.do_checkpoint`` when the net contains fused cells)."""
+    period = max(1, int(period))
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    def on_epoch_end(epoch, symbol=None, arg_params=None, aux_params=None):
+        if (epoch + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, epoch + 1, symbol,
+                                arg_params, aux_params)
 
-    return _callback
+    return on_epoch_end

@@ -1,11 +1,17 @@
-"""RNN cell symbol library (reference: python/mxnet/rnn/rnn_cell.py, 962 LoC).
+"""RNN cell symbol library.
 
-Cells compose Symbols step-by-step (`unroll`), or map onto the fused `RNN`
-op (`FusedRNNCell`) which lowers to lax.scan — the reference's cuDNN path.
-`unfuse()`/pack/unpack_weights convert between the fused flat parameter
-vector (layout documented in ops/rnn_op.py) and per-cell FC weights, so
-unrolled and fused nets interconvert exactly as in the reference
-(tests/python/unittest/test_rnn.py consistency tests).
+Capability parity with the reference's ``python/mxnet/rnn/rnn_cell.py``:
+step-composable cells (``__call__``), graph unrolling (``unroll``), the
+fused multi-layer ``FusedRNNCell`` (lowers to the lax.scan-backed ``RNN``
+op — the cuDNN-path analog), and exact pack/unpack interconversion between
+the fused flat parameter blob and per-cell FC weights (layout documented in
+ops/rnn_op.py).
+
+Structure here differs from the reference: sequence marshalling lives in
+two module-level helpers (``_as_step_list`` / ``_stack_steps``) shared by
+every cell, gate projections go through one ``_linear`` helper, and the
+two container cells (Sequential, Bidirectional) share a ``_MultiCell`` base
+that owns parameter merging and state fan-out.
 """
 from __future__ import annotations
 
@@ -18,31 +24,67 @@ __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
 
 
+# -- sequence marshalling ----------------------------------------------------
+
+
+def _as_step_list(inputs, length, layout, prefix=""):
+    """Normalize ``inputs`` into a list of per-step (N, C) symbols.
+
+    Accepts None (fresh Variables), a single merged symbol (split along the
+    time axis of ``layout``), or an existing list (returned as-is).
+    """
+    if inputs is None:
+        return [symbol.Variable("%st%d_data" % (prefix, t))
+                for t in range(length)]
+    if isinstance(inputs, symbol.Symbol):
+        if len(inputs) != 1:
+            raise MXNetError("unroll expects a single-output symbol")
+        steps = symbol.SliceChannel(inputs, axis=layout.find("T"),
+                                    num_outputs=length, squeeze_axis=1)
+        return [steps[t] for t in range(length)]
+    return list(inputs)
+
+
+def _stack_steps(outputs, time_axis):
+    """Merge a list of per-step symbols into one along a new time axis."""
+    expanded = [symbol.expand_dims(o, axis=time_axis) for o in outputs]
+    return symbol.Concat(*expanded, dim=time_axis)
+
+
+def _linear(data, weight, bias, n_out, name):
+    return symbol.FullyConnected(data=data, weight=weight, bias=bias,
+                                 num_hidden=n_out, name=name)
+
+
+# -- parameter container -----------------------------------------------------
+
+
 class RNNParams:
-    """Container for cell parameters (reference: rnn_cell.py:21)."""
+    """Lazily-created, prefix-namespaced Variable pool shared across steps."""
 
     def __init__(self, prefix=""):
         self._prefix = prefix
         self._params = {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = symbol.Variable(full, **kwargs)
+        return self._params[full]
+
+
+# -- base cell ---------------------------------------------------------------
 
 
 class BaseRNNCell:
-    """Abstract cell (reference: rnn_cell.py:42)."""
+    """Contract: ``__call__(input, states) -> (output, new_states)`` plus
+    ``state_info``/``begin_state`` for state bootstrapping and
+    pack/unpack_weights for fused interop."""
 
     def __init__(self, prefix="", params=None):
-        if params is None:
-            params = RNNParams(prefix)
-            self._own_params = True
-        else:
-            self._own_params = False
+        self._own_params = params is None
+        self._params = params if params is not None else RNNParams(prefix)
         self._prefix = prefix
-        self._params = params
         self._modified = False
         self.reset()
 
@@ -70,63 +112,59 @@ class BaseRNNCell:
     def _gate_names(self):
         return ()
 
-    def begin_state(self, func=None, _batch_ref=None, _ref_axis=0, **kwargs):
-        """Initial states as symbols (reference: rnn_cell.py:129).
+    def _step_name(self):
+        self._counter += 1
+        return "%st%d_" % (self._prefix, self._counter)
 
-        With ``_batch_ref`` (set by unroll), states are zero tensors whose
-        batch dimension follows the data symbol at bind time (the reference's
-        ``func=sym.zeros``); otherwise they are plain Variables the caller
-        must feed."""
-        assert not self._modified, \
-            "After applying modifier cells the base cell cannot be called directly."
+    def begin_state(self, func=None, _batch_ref=None, _ref_axis=0, **kwargs):
+        """Initial-state symbols.
+
+        ``_batch_ref`` (set by unroll) produces zeros whose batch dim tracks
+        a data symbol at bind time; ``func`` delegates construction; the
+        default is plain Variables the caller feeds.
+        """
+        if self._modified:
+            raise MXNetError("cell was wrapped by a modifier; use the "
+                             "modifier's begin_state")
         states = []
         for info in self.state_info:
             self._init_counter += 1
             name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
             if func is not None:
-                state = func(name=name, **kwargs)
+                states.append(func(name=name, **kwargs))
             elif _batch_ref is not None:
-                state = symbol._create(
+                states.append(symbol._create(
                     "_rnn_begin_state", [_batch_ref],
                     {"shape": str(tuple(info["shape"])),
-                     "batch_axis": str(_ref_axis)}, name=name)
+                     "batch_axis": str(_ref_axis)}, name=name))
             else:
-                state = symbol.Variable(name)
-            states.append(state)
+                states.append(symbol.Variable(name))
         return states
 
+    # fused interop: identity for plain cells
     def unpack_weights(self, args):
-        """Unpack fused weights (identity for unfused cells)."""
-        args = dict(args)
-        return args
+        return dict(args)
 
     def pack_weights(self, args):
-        args = dict(args)
-        return args
+        return dict(args)
 
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
-        """Unroll the cell `length` steps (reference: rnn_cell.py:254)."""
+        """Step the cell ``length`` times over the time axis of ``layout``.
+
+        Returns (outputs, final_states); outputs are a per-step list unless
+        ``merge_outputs`` requests one stacked symbol.
+        """
         self.reset()
-        if inputs is None:
-            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
-        elif isinstance(inputs, symbol.Symbol):
-            assert len(inputs) == 1
-            axis = layout.find("T")
-            inputs = getattr(symbol, "SliceChannel")(
-                inputs, axis=axis, num_outputs=length, squeeze_axis=1)
-            inputs = [inputs[i] for i in range(length)]
-        if begin_state is None:
-            begin_state = self.begin_state(_batch_ref=inputs[0], _ref_axis=0)
-        states = begin_state
+        steps = _as_step_list(inputs, length, layout, input_prefix)
+        states = begin_state if begin_state is not None else \
+            self.begin_state(_batch_ref=steps[0], _ref_axis=0)
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        for step in steps:
+            out, states = self(step, states)
+            outputs.append(out)
         if merge_outputs:
-            outputs = [symbol.expand_dims(o, axis=1) for o in outputs]
-            outputs = symbol.Concat(*outputs, dim=1)
+            outputs = _stack_steps(outputs, 1)
         return outputs, states
 
     def _get_activation(self, inputs, activation, **kwargs):
@@ -135,17 +173,19 @@ class BaseRNNCell:
         return activation(inputs, **kwargs)
 
 
-class RNNCell(BaseRNNCell):
-    """Vanilla RNN cell: h' = act(W x + R h + b) (reference: rnn_cell.py:325)."""
+# -- elementary cells --------------------------------------------------------
 
-    def __init__(self, num_hidden, activation="tanh", prefix="rnn_", params=None):
+
+class RNNCell(BaseRNNCell):
+    """Elman cell: h' = act(W_x x + W_h h + b_x + b_h)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
         self._activation = activation
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._w = {k: self.params.get("%s_weight" % k) for k in ("i2h", "h2h")}
+        self._b = {k: self.params.get("%s_bias" % k) for k in ("i2h", "h2h")}
 
     @property
     def state_info(self):
@@ -156,32 +196,28 @@ class RNNCell(BaseRNNCell):
         return ("",)
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
-                                    num_hidden=self._num_hidden,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
-                                    num_hidden=self._num_hidden,
-                                    name="%sh2h" % name)
-        output = self._get_activation(i2h + h2h, self._activation,
-                                      name="%sout" % name)
-        return output, [output]
+        name = self._step_name()
+        pre = _linear(inputs, self._w["i2h"], self._b["i2h"],
+                      self._num_hidden, name + "i2h") \
+            + _linear(states[0], self._w["h2h"], self._b["h2h"],
+                      self._num_hidden, name + "h2h")
+        out = self._get_activation(pre, self._activation, name=name + "out")
+        return out, [out]
 
 
 class LSTMCell(BaseRNNCell):
-    """LSTM cell (reference: rnn_cell.py:365). Gate order i,f,g,o."""
+    """LSTM with gate order i, f, c, o (matches the fused layout)."""
 
-    def __init__(self, num_hidden, prefix="lstm_", params=None, forget_bias=1.0):
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
         super().__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._hW = self.params.get("h2h_weight")
         from ..initializer import LSTMBias
 
-        self._iB = self.params.get("i2h_bias",
-                                   init=LSTMBias(forget_bias=forget_bias))
-        self._hB = self.params.get("h2h_bias")
+        self._num_hidden = num_hidden
+        self._w = {k: self.params.get("%s_weight" % k) for k in ("i2h", "h2h")}
+        self._b = {"i2h": self.params.get(
+                       "i2h_bias", init=LSTMBias(forget_bias=forget_bias)),
+                   "h2h": self.params.get("h2h_bias")}
 
     @property
     def state_info(self):
@@ -193,36 +229,30 @@ class LSTMCell(BaseRNNCell):
         return ("_i", "_f", "_c", "_o")
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%sh2h" % name)
-        gates = i2h + h2h
-        slice_gates = symbol.SliceChannel(gates, num_outputs=4, axis=1,
-                                          name="%sslice" % name)
-        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid")
-        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid")
-        in_transform = symbol.Activation(slice_gates[2], act_type="tanh")
-        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid")
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
-        return next_h, [next_h, next_c]
+        name = self._step_name()
+        h_prev, c_prev = states
+        width = self._num_hidden * 4
+        pre = _linear(inputs, self._w["i2h"], self._b["i2h"], width,
+                      name + "i2h") \
+            + _linear(h_prev, self._w["h2h"], self._b["h2h"], width,
+                      name + "h2h")
+        gate = symbol.SliceChannel(pre, num_outputs=4, axis=1,
+                                   name=name + "slice")
+        sigm = lambda s: symbol.Activation(s, act_type="sigmoid")
+        tanh = lambda s: symbol.Activation(s, act_type="tanh")
+        c_next = sigm(gate[1]) * c_prev + sigm(gate[0]) * tanh(gate[2])
+        h_next = sigm(gate[3]) * tanh(c_next)
+        return h_next, [h_next, c_next]
 
 
 class GRUCell(BaseRNNCell):
-    """GRU cell (reference: rnn_cell.py:428). Gate order r,z,n."""
+    """GRU with gate order r, z, n (matches the fused layout)."""
 
     def __init__(self, num_hidden, prefix="gru_", params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._w = {k: self.params.get("%s_weight" % k) for k in ("i2h", "h2h")}
+        self._b = {k: self.params.get("%s_bias" % k) for k in ("i2h", "h2h")}
 
     @property
     def state_info(self):
@@ -233,39 +263,39 @@ class GRUCell(BaseRNNCell):
         return ("_r", "_z", "_o")
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        prev_state_h = states[0]
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%sh2h" % name)
-        i2h_r, i2h_z, i2h = symbol.SliceChannel(i2h, num_outputs=3,
-                                                name="%si2h_slice" % name)
-        h2h_r, h2h_z, h2h = symbol.SliceChannel(h2h, num_outputs=3,
-                                                name="%sh2h_slice" % name)
-        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
-                                       name="%sr_act" % name)
-        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
-                                        name="%sz_act" % name)
-        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h, act_type="tanh",
-                                       name="%sh_act" % name)
-        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_state_h
-        return next_h, [next_h]
+        name = self._step_name()
+        h_prev = states[0]
+        width = self._num_hidden * 3
+        from_x = symbol.SliceChannel(
+            _linear(inputs, self._w["i2h"], self._b["i2h"], width,
+                    name + "i2h"),
+            num_outputs=3, name=name + "i2h_slice")
+        from_h = symbol.SliceChannel(
+            _linear(h_prev, self._w["h2h"], self._b["h2h"], width,
+                    name + "h2h"),
+            num_outputs=3, name=name + "h2h_slice")
+        reset = symbol.Activation(from_x[0] + from_h[0], act_type="sigmoid",
+                                  name=name + "r_act")
+        update = symbol.Activation(from_x[1] + from_h[1], act_type="sigmoid",
+                                   name=name + "z_act")
+        cand = symbol.Activation(from_x[2] + reset * from_h[2],
+                                 act_type="tanh", name=name + "h_act")
+        h_next = update * h_prev + (1.0 - update) * cand
+        return h_next, [h_next]
+
+
+# -- fused cell --------------------------------------------------------------
 
 
 class FusedRNNCell(BaseRNNCell):
-    """Fused multi-layer RNN mapping onto the `RNN` op (reference: :497)."""
+    """Multi-layer (optionally bidirectional) RNN backed by the fused ``RNN``
+    op.  Cannot be stepped — only unrolled whole."""
 
-    def __init__(self, num_hidden, num_layers=1, mode="lstm", bidirectional=False,
-                 dropout=0.0, get_next_state=False, forget_bias=1.0,
-                 prefix=None, params=None):
-        if prefix is None:
-            prefix = "%s_" % mode
-        super().__init__(prefix=prefix, params=params)
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        super().__init__(prefix="%s_" % mode if prefix is None else prefix,
+                         params=params)
         self._num_hidden = num_hidden
         self._num_layers = num_layers
         self._mode = mode
@@ -273,15 +303,21 @@ class FusedRNNCell(BaseRNNCell):
         self._dropout = dropout
         self._get_next_state = get_next_state
         self._forget_bias = forget_bias
-        self._parameter = self.params.get("parameters")
+        from ..initializer import FusedRNN
+
+        # unpack->init->repack aware initializer rides on the Variable so
+        # Module.init_params initializes the packed blob correctly
+        self._parameter = self.params.get(
+            "parameters", init=FusedRNN(None, num_hidden, num_layers, mode,
+                                        bidirectional, forget_bias))
         self._directions = 2 if bidirectional else 1
 
     @property
     def state_info(self):
-        b = self._directions
-        n = (self._mode == "lstm") + 1
-        return [{"shape": (b * self._num_layers, 0, self._num_hidden),
-                 "__layout__": "LNC"} for _ in range(n)]
+        layers = self._directions * self._num_layers
+        n_states = 2 if self._mode == "lstm" else 1
+        return [{"shape": (layers, 0, self._num_hidden),
+                 "__layout__": "LNC"}] * n_states
 
     @property
     def _gate_names(self):
@@ -289,153 +325,174 @@ class FusedRNNCell(BaseRNNCell):
                 "lstm": ["_i", "_f", "_c", "_o"],
                 "gru": ["_r", "_z", "_o"]}[self._mode]
 
+    def __call__(self, inputs, states):
+        raise MXNetError("FusedRNNCell cannot be stepped; use unroll()")
+
+    # -- packed-parameter interop -------------------------------------------
     def _param_layout(self, input_size):
         return _layout(self._num_layers, self._num_hidden, self._mode,
                        self._bidirectional, input_size)
 
-    def unpack_weights(self, args, input_size=None):
-        """Split the flat `parameters` array into per-matrix numpy views."""
+    def _infer_input_size(self, flat):
+        """Invert the parameter-count formula for the input width."""
         import numpy as np
 
-        args = dict(args)
-        arr = args.pop(self._prefix + "parameters")
-        if hasattr(arr, "asnumpy"):
-            arr = arr.asnumpy()
-        arr = np.asarray(arr)
+        g, d, H, L = (_gates(self._mode), self._directions,
+                      self._num_hidden, self._num_layers)
+        # flat.size = d*g*H*input + [first-layer h2h + upper layers + biases]
+        fixed = d * g * H * H \
+            + (L - 1) * d * g * H * (H * d + H) \
+            + L * d * 2 * g * H
+        return (int(flat.size) - fixed) // (d * g * H)
+
+    def unpack_weights(self, args, input_size=None):
+        """Flat ``parameters`` blob -> individual lX_dY_{i2h,h2h}_* arrays."""
+        import numpy as np
+
+        out = dict(args)
+        flat = out.pop(self._prefix + "parameters")
+        flat = np.asarray(flat.asnumpy() if hasattr(flat, "asnumpy")
+                          else flat)
         if input_size is None:
-            input_size = self._infer_input_size(arr)
-        for name, off, shape in self._param_layout(input_size):
-            n = int(np.prod(shape))
-            args[self._prefix + name] = arr[off:off + n].reshape(shape).copy()
-        return args
+            input_size = self._infer_input_size(flat)
+        for name, offset, shape in self._param_layout(input_size):
+            count = int(np.prod(shape))
+            out[self._prefix + name] = \
+                flat[offset:offset + count].reshape(shape).copy()
+        return out
 
     def pack_weights(self, args, input_size=None):
+        """Individual per-gate arrays -> flat ``parameters`` blob."""
         import numpy as np
 
-        args = dict(args)
-        pieces = {}
-        for key in list(args.keys()):
-            if key.startswith(self._prefix) and ("_i2h_" in key or "_h2h_" in key):
-                pieces[key[len(self._prefix):]] = args.pop(key)
-        any_piece = next(iter(pieces.values()))
-        first_w = pieces.get("l0_d0_i2h_weight")
+        out = dict(args)
+        pieces = {k[len(self._prefix):]: out.pop(k)
+                  for k in list(out)
+                  if k.startswith(self._prefix)
+                  and ("_i2h_" in k or "_h2h_" in k)}
+
+        def host(v):
+            return np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+
         if input_size is None:
-            input_size = np.asarray(first_w).shape[-1]
-        total = rnn_param_size(self._num_layers, self._num_hidden, self._mode,
-                               self._bidirectional, input_size)
-        flat = np.zeros((total,), dtype=np.asarray(any_piece).dtype)
-        for name, off, shape in self._param_layout(input_size):
-            v = pieces[name]
-            if hasattr(v, "asnumpy"):
-                v = v.asnumpy()
-            flat[off:off + int(np.prod(shape))] = np.asarray(v).reshape(-1)
-        args[self._prefix + "parameters"] = flat
-        return args
+            input_size = host(pieces["l0_d0_i2h_weight"]).shape[-1]
+        flat = np.zeros(rnn_param_size(self._num_layers, self._num_hidden,
+                                       self._mode, self._bidirectional,
+                                       input_size),
+                        dtype=host(next(iter(pieces.values()))).dtype)
+        for name, offset, shape in self._param_layout(input_size):
+            count = int(np.prod(shape))
+            flat[offset:offset + count] = host(pieces[name]).reshape(-1)
+        out[self._prefix + "parameters"] = flat
+        return out
 
-    def _infer_input_size(self, flat):
-        """Solve for input_size from the flat parameter count."""
-        g = _gates(self._mode)
-        d = self._directions
-        H = self._num_hidden
-        L = self._num_layers
-        total = flat.size
-        # total = d*g*H*I + d*g*H*H + (L-1)*d*g*H*(H*d + H) + L*d*2*g*H
-        rest = d * g * H * H + (L - 1) * d * g * H * (H * d + H) + L * d * 2 * g * H
-        return (total - rest) // (d * g * H)
-
-    def __call__(self, inputs, states):
-        raise MXNetError("FusedRNNCell cannot be stepped; use unroll")
-
+    # -- graph construction ---------------------------------------------------
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
         self.reset()
-        axis = layout.find("T")
-        if inputs is None:
-            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
-        if isinstance(inputs, list):
-            inputs = [symbol.expand_dims(i, axis=0) for i in inputs]
-            inputs = symbol.Concat(*inputs, dim=0)  # TNC
+        time_axis = layout.find("T")
+        # the RNN op wants TNC; merge lists ourselves along axis 0
+        if inputs is None or isinstance(inputs, list):
+            steps = _as_step_list(inputs, length, layout, input_prefix)
+            seq = _stack_steps(steps, 0)
+        elif time_axis == 1:
+            seq = symbol.SwapAxis(inputs, dim1=0, dim2=1)
         else:
-            if axis == 1:  # NTC -> TNC
-                inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
-        if begin_state is None:
-            begin_state = self.begin_state(_batch_ref=inputs, _ref_axis=1)
-        states = list(begin_state)
+            seq = inputs
+        states = begin_state if begin_state is not None else \
+            self.begin_state(_batch_ref=seq, _ref_axis=1)
 
-        rnn_args = dict(state_size=self._num_hidden, num_layers=self._num_layers,
-                        bidirectional=self._bidirectional, mode=self._mode,
-                        p=self._dropout, state_outputs=self._get_next_state,
-                        name="%srnn" % self._prefix)
-        if self._mode == "lstm":
-            rnn = symbol.RNN(inputs, self._parameter, states[0], states[1],
-                             **rnn_args)
-        else:
-            rnn = symbol.RNN(inputs, self._parameter, states[0], **rnn_args)
+        rnn = symbol.RNN(seq, self._parameter, *states,
+                         state_size=self._num_hidden,
+                         num_layers=self._num_layers,
+                         bidirectional=self._bidirectional, mode=self._mode,
+                         p=self._dropout, state_outputs=self._get_next_state,
+                         name="%srnn" % self._prefix)
 
         if self._get_next_state:
             outputs = rnn[0]
-            next_states = [rnn[i] for i in range(1, len(self.state_info) + 1)]
+            next_states = [rnn[i + 1]
+                           for i in range(len(self.state_info))]
         else:
             outputs = rnn if len(rnn) == 1 else rnn[0]
             next_states = []
 
-        if axis == 1:
+        if time_axis == 1:
             outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
         if not merge_outputs:
-            outputs = symbol.SliceChannel(outputs, axis=axis, num_outputs=length,
-                                          squeeze_axis=1)
-            outputs = [outputs[i] for i in range(length)]
+            split = symbol.SliceChannel(outputs, axis=time_axis,
+                                        num_outputs=length, squeeze_axis=1)
+            outputs = [split[t] for t in range(length)]
         return outputs, next_states
 
     def unfuse(self):
-        """Equivalent unfused SequentialRNNCell (reference: rnn_cell.py:604)."""
-        stack = SequentialRNNCell()
-        get_cell = {
-            "rnn_relu": lambda p: RNNCell(self._num_hidden, activation="relu",
-                                          prefix=p),
-            "rnn_tanh": lambda p: RNNCell(self._num_hidden, activation="tanh",
-                                          prefix=p),
+        """Equivalent stack of unfused cells (prefixes line up with the
+        packed layout, so weights transfer via pack/unpack)."""
+        factories = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                          activation="relu", prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                          activation="tanh", prefix=p),
             "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
             "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
-        }[self._mode]
-        for i in range(self._num_layers):
+        }
+        make = factories[self._mode]
+        stack = SequentialRNNCell()
+        for layer in range(self._num_layers):
             if self._bidirectional:
                 stack.add(BidirectionalCell(
-                    get_cell("%sl%d_d0_" % (self._prefix, i)),
-                    get_cell("%sl%d_d1_" % (self._prefix, i)),
-                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+                    make("%sl%d_d0_" % (self._prefix, layer)),
+                    make("%sl%d_d1_" % (self._prefix, layer)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, layer)))
             else:
-                stack.add(get_cell("%sl%d_d0_" % (self._prefix, i)))
-            if self._dropout > 0 and i != self._num_layers - 1:
-                stack.add(DropoutCell(self._dropout,
-                                      prefix="%s_dropout%d_" % (self._prefix, i)))
+                stack.add(make("%sl%d_d0_" % (self._prefix, layer)))
+            if self._dropout > 0 and layer + 1 < self._num_layers:
+                stack.add(DropoutCell(
+                    self._dropout,
+                    prefix="%s_dropout%d_" % (self._prefix, layer)))
         return stack
 
 
-class SequentialRNNCell(BaseRNNCell):
-    """Stack of cells (reference: rnn_cell.py:685)."""
+# -- container cells ---------------------------------------------------------
 
-    def __init__(self, params=None):
-        super().__init__(prefix="", params=params)
+
+class _MultiCell(BaseRNNCell):
+    """Shared machinery for cells made of child cells: parameter merging,
+    state fan-out, and pack/unpack delegation."""
+
+    def __init__(self, params=None, prefix=""):
+        super().__init__(prefix=prefix, params=params)
         self._override_cell_params = params is not None
         self._cells = []
 
-    def add(self, cell):
-        self._cells.append(cell)
+    def _adopt(self, cell):
         if self._override_cell_params:
-            assert cell._own_params, \
-                "Either specify params for SequentialRNNCell or child cells, not both."
+            if not cell._own_params:
+                raise MXNetError("give params to the container or to the "
+                                 "child cells, not both")
             cell.params._params.update(self.params._params)
         self.params._params.update(cell.params._params)
+        self._cells.append(cell)
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return [info for c in self._cells for info in c.state_info]
 
     def begin_state(self, **kwargs):
-        assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        if self._modified:
+            raise MXNetError("cell was wrapped by a modifier; use the "
+                             "modifier's begin_state")
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
+
+    def _split_states(self, states):
+        """Slice a flat state list into per-child chunks."""
+        chunks, pos = [], 0
+        for cell in self._cells:
+            width = len(cell.state_info)
+            chunks.append(states[pos:pos + width] if states is not None
+                          else None)
+            pos += width
+        return chunks
 
     def unpack_weights(self, args):
         for cell in self._cells:
@@ -447,41 +504,82 @@ class SequentialRNNCell(BaseRNNCell):
             args = cell.pack_weights(args)
         return args
 
+
+class SequentialRNNCell(_MultiCell):
+    """Vertical stack: each child consumes the previous child's output."""
+
+    def __init__(self, params=None):
+        super().__init__(params=params)
+
+    def add(self, cell):
+        self._adopt(cell)
+
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._cells:
-            assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info)
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        new_states = []
+        for cell, chunk in zip(self._cells, self._split_states(list(states))):
+            if isinstance(cell, BidirectionalCell):
+                raise MXNetError("BidirectionalCell cannot be stepped inside "
+                                 "SequentialRNNCell; unroll instead")
+            inputs, out_states = cell(inputs, chunk)
+            new_states.extend(out_states)
+        return inputs, new_states
 
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
-        # unroll layer by layer so Bidirectional/Fused children work
+        # layer-wise unroll so Fused/Bidirectional children work
         self.reset()
-        num_cells = len(self._cells)
-        p = 0
-        next_states = []
         outputs = inputs
-        for i, cell in enumerate(self._cells):
-            n = len(cell.state_info)
-            states = begin_state[p:p + n] if begin_state is not None else None
-            p += n
+        final_states = []
+        chunks = self._split_states(begin_state)
+        last = len(self._cells) - 1
+        for i, (cell, chunk) in enumerate(zip(self._cells, chunks)):
             outputs, states = cell.unroll(
-                length, inputs=outputs, begin_state=states,
+                length, inputs=outputs, begin_state=chunk,
                 input_prefix=input_prefix, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return outputs, next_states
+                merge_outputs=merge_outputs if i == last else None)
+            final_states.extend(states)
+        return outputs, final_states
+
+
+class BidirectionalCell(_MultiCell):
+    """Runs one child forward and one backward over time, concatenating the
+    per-step outputs on the feature axis."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(params=params)
+        self._output_prefix = output_prefix
+        self._adopt(l_cell)
+        self._adopt(r_cell)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll()")
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        steps = _as_step_list(inputs, length, layout, input_prefix)
+        fwd_cell, bwd_cell = self._cells
+        fwd_begin, bwd_begin = self._split_states(begin_state)
+        fwd_out, fwd_states = fwd_cell.unroll(
+            length, inputs=steps, begin_state=fwd_begin, layout=layout,
+            merge_outputs=False)
+        bwd_out, bwd_states = bwd_cell.unroll(
+            length, inputs=steps[::-1], begin_state=bwd_begin, layout=layout,
+            merge_outputs=False)
+        outputs = [symbol.Concat(f, b, dim=1,
+                                 name="%st%d" % (self._output_prefix, t))
+                   for t, (f, b) in enumerate(zip(fwd_out, bwd_out[::-1]))]
+        if merge_outputs:
+            outputs = _stack_steps(outputs, 1)
+        return outputs, fwd_states + bwd_states
+
+
+# -- pass-through / wrapper cells ---------------------------------------------
 
 
 class DropoutCell(BaseRNNCell):
-    """Dropout between layers (reference: rnn_cell.py:763)."""
+    """Stateless dropout between stacked layers."""
 
     def __init__(self, dropout, prefix="dropout_", params=None):
         super().__init__(prefix=prefix, params=params)
@@ -499,15 +597,17 @@ class DropoutCell(BaseRNNCell):
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
         self.reset()
+        # a merged symbol can be masked in one shot
         if isinstance(inputs, symbol.Symbol) and merge_outputs is not False:
-            output, _ = self(inputs, [])
-            return output, []
-        return super().unroll(length, inputs, begin_state, input_prefix, layout,
-                              merge_outputs)
+            out, _ = self(inputs, [])
+            return out, []
+        return super().unroll(length, inputs, begin_state, input_prefix,
+                              layout, merge_outputs)
 
 
 class ModifierCell(BaseRNNCell):
-    """Base for cells wrapping another cell (reference: rnn_cell.py:797)."""
+    """Wraps a base cell, borrowing its params/states; subclasses override
+    ``__call__`` to decorate the step function."""
 
     def __init__(self, base_cell):
         super().__init__()
@@ -524,11 +624,13 @@ class ModifierCell(BaseRNNCell):
         return self.base_cell.state_info
 
     def begin_state(self, init_sym=None, **kwargs):
-        assert not self._modified
+        if self._modified:
+            raise MXNetError("doubly-modified cell; unwrap first")
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(**kwargs)
-        self.base_cell._modified = True
-        return begin
+        try:
+            return self.base_cell.begin_state(**kwargs)
+        finally:
+            self.base_cell._modified = True
 
     def unpack_weights(self, args):
         return self.base_cell.unpack_weights(args)
@@ -541,11 +643,13 @@ class ModifierCell(BaseRNNCell):
 
 
 class ZoneoutCell(ModifierCell):
-    """Zoneout regularization (reference: rnn_cell.py:839)."""
+    """Zoneout (Krueger et al.): randomly carry previous outputs/states
+    through instead of the new values."""
 
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
-        assert not isinstance(base_cell, FusedRNNCell), \
-            "FusedRNNCell does not support zoneout; unfuse() first."
+        if isinstance(base_cell, FusedRNNCell):
+            raise MXNetError("zoneout needs per-step access; unfuse() the "
+                             "FusedRNNCell first")
         super().__init__(base_cell)
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states
@@ -555,108 +659,28 @@ class ZoneoutCell(ModifierCell):
         super().reset()
         self.prev_output = None
 
-    def __call__(self, inputs, states):
-        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
-                                     self.zoneout_states)
-        next_output, next_states = cell(inputs, states)
-        mask = lambda p, like: symbol.Dropout(
-            symbol.ones_like(like), p=p)
+    @staticmethod
+    def _carry(p, new, old):
+        """new where a Bernoulli(1-p) mask fires, else old."""
+        keep_mask = symbol.Dropout(symbol.ones_like(new), p=p)
+        return symbol.where(keep_mask, new, old)
 
-        prev_output = self.prev_output if self.prev_output is not None \
-            else symbol.zeros_like(next_output)
-        output = (symbol.where(mask(p_outputs, next_output), next_output,
-                               prev_output)
-                  if p_outputs != 0.0 else next_output)
-        states = ([symbol.where(mask(p_states, new_s), new_s, old_s)
-                   for new_s, old_s in zip(next_states, states)]
-                  if p_states != 0.0 else next_states)
+    def __call__(self, inputs, states):
+        new_output, new_states = self.base_cell(inputs, states)
+        prev = self.prev_output if self.prev_output is not None \
+            else symbol.zeros_like(new_output)
+        output = self._carry(self.zoneout_outputs, new_output, prev) \
+            if self.zoneout_outputs else new_output
+        if self.zoneout_states:
+            new_states = [self._carry(self.zoneout_states, s_new, s_old)
+                          for s_new, s_old in zip(new_states, states)]
         self.prev_output = output
-        return output, states
+        return output, new_states
 
 
 class ResidualCell(ModifierCell):
-    """Residual connection around a cell."""
+    """Adds the cell input to its output (He-style skip over the step)."""
 
     def __call__(self, inputs, states):
         output, states = self.base_cell(inputs, states)
-        output = output + inputs
-        return output, states
-
-
-class BidirectionalCell(BaseRNNCell):
-    """Bidirectional wrapper (reference: rnn_cell.py:881)."""
-
-    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
-        super().__init__("", params=params)
-        self._output_prefix = output_prefix
-        self._override_cell_params = params is not None
-        if self._override_cell_params:
-            assert l_cell._own_params and r_cell._own_params
-            l_cell.params._params.update(self.params._params)
-            r_cell.params._params.update(self.params._params)
-        self.params._params.update(l_cell.params._params)
-        self.params._params.update(r_cell.params._params)
-        self._cells = [l_cell, r_cell]
-
-    def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
-
-    def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
-
-    def __call__(self, inputs, states):
-        raise MXNetError("Bidirectional cannot be stepped. Please use unroll")
-
-    @property
-    def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
-
-    def begin_state(self, **kwargs):
-        assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
-
-    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
-               layout="NTC", merge_outputs=None):
-        self.reset()
-        if inputs is None:
-            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
-        elif isinstance(inputs, symbol.Symbol):
-            axis = layout.find("T")
-            inputs = symbol.SliceChannel(inputs, axis=axis, num_outputs=length,
-                                         squeeze_axis=1)
-            inputs = [inputs[i] for i in range(length)]
-        l_cell, r_cell = self._cells
-        if begin_state is None:
-            l_begin = r_begin = None
-        else:
-            l_begin = begin_state[:len(l_cell.state_info)]
-            r_begin = begin_state[len(l_cell.state_info):]
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs, begin_state=l_begin,
-            layout=layout, merge_outputs=False)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=r_begin,
-            layout=layout, merge_outputs=False)
-        outputs = [symbol.Concat(l_o, r_o, dim=1,
-                                 name="%st%d" % (self._output_prefix, i))
-                   for i, (l_o, r_o) in enumerate(zip(l_outputs,
-                                                      reversed(r_outputs)))]
-        if merge_outputs:
-            outputs = [symbol.expand_dims(o, axis=1) for o in outputs]
-            outputs = symbol.Concat(*outputs, dim=1)
-        states = l_states + r_states
-        return outputs, states
-
-
-def _cells_unpack_weights(cells, args):
-    for cell in cells:
-        args = cell.unpack_weights(args)
-    return args
-
-
-def _cells_pack_weights(cells, args):
-    for cell in cells:
-        args = cell.pack_weights(args)
-    return args
+        return output + inputs, states
