@@ -39,6 +39,7 @@ class Executor:
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self._group2ctx = group2ctx or {}
+        self._placement = self._plan_placement(symbol, self._group2ctx)
         self._monitor_callback = None
 
         arg_names = symbol.list_arguments()
@@ -84,6 +85,8 @@ class Executor:
             self.aux_dict = dict(zip(aux_names, aux_states))
         self.aux_arrays = [self.aux_dict[n] for n in aux_names]
 
+        if self._placement:
+            self._place_buffers()
         self._arg_names = arg_names
         self._aux_names = aux_names
         self._grad_names = [n for n in arg_names
@@ -92,6 +95,68 @@ class Executor:
         self._cached_grads = None
         self._fn_cache = {}
         self.outputs_ready = False
+
+    # ------------------------------------------------------------------
+    # model-parallel placement (group2ctx)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _plan_placement(symbol, group2ctx):
+        """Map node name -> jax.Device from ``ctx_group`` attrs.
+
+        The reference's AssignContext/PlaceDevice pass
+        (graph_executor.cc:242-331): nodes carrying a ``ctx_group`` attr run
+        on the mapped device and ``_CrossDeviceCopy`` is inserted at cut
+        edges — here the copies are ``jax.device_put`` at op boundaries
+        (see _run_graph), and XLA async dispatch provides the cross-device
+        overlap the reference got from its engine.  A group with no mapping
+        raises rather than silently replicating.  Returns None when no
+        placement is requested.
+        """
+        if not group2ctx:
+            return None
+        placement = {}
+        devices = set()
+        for node in symbol._topo():
+            group = node.attrs.get("ctx_group") if node.attrs else None
+            if group is None:
+                continue
+            if group not in group2ctx:
+                raise MXNetError(
+                    "ctx_group %r on node %r has no entry in group2ctx "
+                    "(mapped groups: %s)" % (group, node.name,
+                                             sorted(group2ctx)))
+            ctx = group2ctx[group]
+            ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+            placement[node.name] = ctx.jax_device
+        return placement or None
+
+    @property
+    def _default_device(self):
+        """Device for nodes with no ctx_group under placement."""
+        dev = getattr(self, "_default_dev_cache", None)
+        if dev is None:
+            dev = self._ctx.jax_device
+            self._default_dev_cache = dev
+        return dev
+
+    def _place_buffers(self):
+        """Make parameter/gradient NDArrays resident on their placed device
+        so steady-state steps do no cross-device parameter traffic (the
+        reference allocates each node's arrays on its assigned device)."""
+        import jax
+
+        for name, arr in list(self.arg_dict.items()):
+            dev = self._placement.get(name)
+            if dev is not None and arr.data.devices() != {dev}:
+                arr._set_data(jax.device_put(arr.data, dev))
+        for name, arr in list(self.grad_dict.items()):
+            dev = self._placement.get(name)
+            if dev is not None and arr.data.devices() != {dev}:
+                arr._set_data(jax.device_put(arr.data, dev))
+        for name, arr in list(self.aux_dict.items()):
+            dev = self._placement.get(name)
+            if dev is not None and arr.data.devices() != {dev}:
+                arr._set_data(jax.device_put(arr.data, dev))
 
     # ------------------------------------------------------------------
     # graph execution as a pure function
@@ -132,8 +197,21 @@ class Executor:
             n_args = node.op.n_inputs(attrs)
             ins = [values[(id(s), i)] for s, i in node.inputs[:n_args]]
             aux_ins = [values[(id(s), i)] for s, i in node.inputs[n_args:]]
-            octx = OpContext(is_train=is_train,
-                             rng=jax.random.fold_in(rng, seq) if rng is not None else None)
+            node_rng = jax.random.fold_in(rng, seq) if rng is not None \
+                else None
+            if self._placement is not None:
+                # cut-edge transfer (the _CrossDeviceCopy analog): inputs
+                # move to this node's device — unannotated nodes run on the
+                # bind ctx, like the reference's PlaceDevice default.
+                # device_put is a no-op for values already in place, and
+                # its transpose moves cotangents back, so backward
+                # transfers fall out of vjp
+                dev = self._placement.get(node.name, self._default_device)
+                ins = [jax.device_put(v, dev) for v in ins]
+                aux_ins = [jax.device_put(v, dev) for v in aux_ins]
+                if node_rng is not None:
+                    node_rng = jax.device_put(node_rng, dev)
+            octx = OpContext(is_train=is_train, rng=node_rng)
             with jax.named_scope(node.name):
                 if spans:
                     with _prof.Scope(node.name):
@@ -213,8 +291,11 @@ class Executor:
 
         # MXNET_ENGINE_TYPE=NaiveEngine: run everything eagerly op-by-op
         # (the reference's debugging engine); bulk-exec-inference off does
-        # the same for inference graphs only
-        compiled = _config.get("MXNET_ENGINE_TYPE") != "NaiveEngine"
+        # the same for inference graphs only.  group2ctx placement also
+        # runs eagerly: each op dispatches async onto its own device (the
+        # engine-overlap model), since one jit program owns one device set.
+        compiled = _config.get("MXNET_ENGINE_TYPE") != "NaiveEngine" \
+            and self._placement is None
         if kind == "fwd_test" and not _config.get("MXNET_EXEC_BULK_EXEC_INFERENCE"):
             compiled = False
 

@@ -1,0 +1,148 @@
+"""Model-parallel (group2ctx) tests.
+
+Reference analog: tests/python/unittest/test_model_parallel.py +
+test_multi_device_exec.py — fake mx.cpu(N) devices stand in for a
+multi-chip box (the conftest's 8 virtual XLA-CPU devices are genuinely
+distinct devices here, so transfers are real).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def _chain_net():
+    data1 = sym.Variable("data1")
+    data2 = sym.Variable("data2")
+    with mx.AttrScope(ctx_group="dev1"):
+        net = data1 + data2
+        net = net * 3.0
+    with mx.AttrScope(ctx_group="dev2"):
+        net = net + data1
+    return net
+
+
+def test_group2ctx_matches_single_device():
+    shape = (4, 5)
+    net = _chain_net()
+    vals = [np.random.RandomState(3).rand(*shape).astype(np.float32),
+            np.random.RandomState(4).rand(*shape).astype(np.float32)]
+
+    args_mp = [nd.array(v) for v in vals]
+    grads_mp = [nd.zeros(shape), nd.zeros(shape)]
+    exe_mp = net.bind(mx.cpu(), args=args_mp, args_grad=grads_mp,
+                      group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+
+    args_sd = [nd.array(v) for v in vals]
+    grads_sd = [nd.zeros(shape), nd.zeros(shape)]
+    exe_sd = net.bind(mx.cpu(), args=args_sd, args_grad=grads_sd)
+
+    out_mp = exe_mp.forward(is_train=True)[0].asnumpy()
+    out_sd = exe_sd.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out_mp, out_sd, rtol=1e-6)
+
+    head = nd.ones(shape)
+    exe_mp.backward([head])
+    exe_sd.backward([head])
+    for g_mp, g_sd in zip(grads_mp, grads_sd):
+        np.testing.assert_allclose(g_mp.asnumpy(), g_sd.asnumpy(), rtol=1e-6)
+
+
+def test_group2ctx_places_nodes_on_distinct_devices():
+    import jax
+
+    net = _chain_net()
+    exe = net.bind(mx.cpu(), args=[nd.ones((2, 2)), nd.ones((2, 2))],
+                   group2ctx={"dev1": mx.cpu(2), "dev2": mx.cpu(5)})
+    assert exe._placement is not None
+    devs = set(exe._placement.values())
+    assert len(devs) == 2
+    # output comes from the dev2 stage
+    out = exe.forward()[0]
+    assert out.data.devices() == {mx.cpu(5).jax_device}
+
+
+def test_group2ctx_join_on_default_device():
+    """An unannotated op joining two placed groups runs on the bind ctx
+    with transfers inserted (reference PlaceDevice default)."""
+    d1, d2 = sym.Variable("d1"), sym.Variable("d2")
+    with mx.AttrScope(ctx_group="g1"):
+        x = d1 * 2.0
+    with mx.AttrScope(ctx_group="g2"):
+        y = d2 * 3.0
+    net = x + y  # no ctx_group
+    exe = net.bind(mx.cpu(0), args=[nd.ones((2, 2)), nd.ones((2, 2))],
+                   group2ctx={"g1": mx.cpu(1), "g2": mx.cpu(2)})
+    out = exe.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 5.0))
+    assert out.data.devices() == {mx.cpu(0).jax_device}
+
+
+def test_group2ctx_weights_resident_on_placed_device():
+    """Parameters created inside an AttrScope live on their group's device
+    after bind — no per-step parameter transfers."""
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="s0"):
+        net = sym.FullyConnected(data, num_hidden=4, name="fc0")
+    exe = net.simple_bind(mx.cpu(0), group2ctx={"s0": mx.cpu(3)},
+                          data=(2, 3))
+    assert exe.arg_dict["fc0_weight"].data.devices() == \
+        {mx.cpu(3).jax_device}
+    assert exe.grad_dict["fc0_weight"].data.devices() == \
+        {mx.cpu(3).jax_device}
+    exe.forward(is_train=True)
+    exe.backward()
+    # gradient lands back on the weight's device
+    assert exe.grad_dict["fc0_weight"].data.devices() == \
+        {mx.cpu(3).jax_device}
+
+
+def test_group2ctx_unknown_group_raises():
+    net = _chain_net()
+    with pytest.raises(MXNetError):
+        net.bind(mx.cpu(), args=[nd.ones((2, 2)), nd.ones((2, 2))],
+                 group2ctx={"dev1": mx.cpu(0)})  # dev2 missing
+
+
+def test_model_parallel_mlp_training():
+    """Two FC stages on different devices train to the same result as one
+    device (weights, outputs, and gradients all agree)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 6).astype(np.float32)
+
+    def build():
+        data = sym.Variable("data")
+        with mx.AttrScope(ctx_group="stage0"):
+            h = sym.Activation(sym.FullyConnected(
+                data, num_hidden=16, name="fc0"), act_type="relu")
+        with mx.AttrScope(ctx_group="stage1"):
+            out = sym.FullyConnected(h, num_hidden=4, name="fc1")
+        return sym.MakeLoss(sym.sum(out * out))
+
+    net = build()
+    arg_shapes, _, _ = net.infer_shape(data=(8, 6))
+    names = net.list_arguments()
+    init = {n: rng.randn(*s).astype(np.float32) * 0.1
+            for n, s in zip(names, arg_shapes)}
+    init["data"] = x
+
+    exes = {}
+    for key, g2c in (("mp", {"stage0": mx.cpu(1), "stage1": mx.cpu(3)}),
+                     ("sd", None)):
+        args = {n: nd.array(v) for n, v in init.items()}
+        grads = {n: nd.zeros(v.shape) for n, v in init.items()
+                 if n != "data"}
+        exes[key] = net.bind(mx.cpu(), args=args, args_grad=grads,
+                             group2ctx=g2c)
+    for exe in exes.values():
+        exe.forward(is_train=True)
+        exe.backward()
+    np.testing.assert_allclose(exes["mp"].outputs[0].asnumpy(),
+                               exes["sd"].outputs[0].asnumpy(), rtol=1e-5)
+    for n in exes["mp"].grad_dict:
+        np.testing.assert_allclose(exes["mp"].grad_dict[n].asnumpy(),
+                                   exes["sd"].grad_dict[n].asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
