@@ -115,7 +115,6 @@ class Executor:
         if not group2ctx:
             return None
         placement = {}
-        devices = set()
         for node in symbol._topo():
             group = node.attrs.get("ctx_group") if node.attrs else None
             if group is None:
@@ -145,18 +144,11 @@ class Executor:
         reference allocates each node's arrays on its assigned device)."""
         import jax
 
-        for name, arr in list(self.arg_dict.items()):
-            dev = self._placement.get(name)
-            if dev is not None and arr.data.devices() != {dev}:
-                arr._set_data(jax.device_put(arr.data, dev))
-        for name, arr in list(self.grad_dict.items()):
-            dev = self._placement.get(name)
-            if dev is not None and arr.data.devices() != {dev}:
-                arr._set_data(jax.device_put(arr.data, dev))
-        for name, arr in list(self.aux_dict.items()):
-            dev = self._placement.get(name)
-            if dev is not None and arr.data.devices() != {dev}:
-                arr._set_data(jax.device_put(arr.data, dev))
+        for pool in (self.arg_dict, self.grad_dict, self.aux_dict):
+            for name, arr in pool.items():
+                dev = self._placement.get(name)
+                if dev is not None and arr.data.devices() != {dev}:
+                    arr._set_data(jax.device_put(arr.data, dev))
 
     # ------------------------------------------------------------------
     # graph execution as a pure function

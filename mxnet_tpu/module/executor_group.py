@@ -40,9 +40,10 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=logging, fixed_param_names=None, grad_req="write",
-                 state_names=None):
+                 state_names=None, mesh_config=None):
         self.symbol = symbol
         self.contexts = contexts
+        self.mesh_config = mesh_config
         self.param_names = param_names
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
@@ -58,9 +59,14 @@ class DataParallelExecutorGroup:
         self.label_names = [d.name for d in self.label_shapes]
 
         self.batch_size = self.data_shapes[0].shape[0]
-        if self.batch_size % max(1, len(contexts)) != 0:
-            raise MXNetError("batch size %d must be divisible by the number of "
-                             "contexts %d" % (self.batch_size, len(contexts)))
+        self._data_par = len(contexts)
+        if mesh_config is not None:
+            sizes = mesh_config.resolve(len(contexts))
+            self._data_par = sizes[mesh_config.names.index("data")]
+        if self.batch_size % max(1, self._data_par) != 0:
+            raise MXNetError("batch size %d must be divisible by the data-"
+                             "parallel degree %d" % (self.batch_size,
+                                                     self._data_par))
 
         # gradient requests
         if isinstance(grad_req, str):
@@ -83,6 +89,10 @@ class DataParallelExecutorGroup:
         self._mesh = None
         self._data_sharding = None
         self._rep_sharding = None
+        self._model_par = 1
+        # params (and their aux/grads) eligible for tensor-parallel
+        # annotation; inputs/labels never are
+        self._tp_param_names = set(self.param_names) | set(self.aux_names)
         if len(contexts) > 1:
             self._build_mesh()
 
@@ -90,7 +100,6 @@ class DataParallelExecutorGroup:
 
     # ------------------------------------------------------------------
     def _build_mesh(self):
-        import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         devices = [c.jax_device for c in self.contexts]
@@ -100,20 +109,53 @@ class DataParallelExecutorGroup:
             self.logger.debug("contexts map to %d physical device(s); running "
                               "unsharded", len(set(devices)))
             return
-        self._mesh = Mesh(np.array(devices), ("data",))
+        if self.mesh_config is not None:
+            from ..parallel.mesh import build_mesh
+
+            self._mesh = build_mesh(self.mesh_config, devices)
+            self._model_par = dict(zip(self.mesh_config.names,
+                                       self.mesh_config.resolve(
+                                           len(devices))))["model"]
+        else:
+            self._mesh = Mesh(np.array(devices), ("data",))
+            self._model_par = 1
         self._data_sharding = NamedSharding(self._mesh, P("data"))
         self._rep_sharding = NamedSharding(self._mesh, P())
 
-    def _place(self, arr, sharded):
-        """device_put an NDArray's buffer onto the bound device(s): mesh
-        NamedSharding when data-parallel, else the single bound device (so a
-        host-built batch moves to TPU).  No-op when already placed."""
+    def _param_sharding(self, name, shape):
+        """Tensor-parallel sharding rule over the 'model' mesh axis.
+
+        The scaling-book recipe rather than hand-written psums: weights are
+        annotated — FullyConnected/Convolution outputs (dim 0) sharded on
+        'model', matching biases/BatchNorm params likewise — and the GSPMD
+        partitioner derives the activation shardings and inserts the
+        all-gathers/psums (Megatron-style column parallelism).  Params whose
+        leading dim doesn't divide evenly stay replicated.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._model_par <= 1 or not shape or \
+                shape[0] % self._model_par != 0:
+            return self._rep_sharding
+        return NamedSharding(self._mesh,
+                             P(*(["model"] + [None] * (len(shape) - 1))))
+
+    def _place(self, arr, sharded, name=None):
+        """device_put an NDArray's buffer onto the bound device(s): data
+        sharding for batch inputs, the tensor-parallel rule for named
+        params (replicated when model==1), else replicated.  No-op when
+        already placed."""
         import jax
 
         if self._mesh is None:
             target = self.contexts[0].jax_device
+        elif sharded:
+            target = self._data_sharding
+        elif name is not None and self._model_par > 1 \
+                and name in self._tp_param_names:
+            target = self._param_sharding(name, arr.shape)
         else:
-            target = self._data_sharding if sharded else self._rep_sharding
+            target = self._rep_sharding
         arr._set_data(jax.device_put(arr.data, target))
         return arr
 
@@ -126,13 +168,15 @@ class DataParallelExecutorGroup:
         exec_ = Executor.simple_bind(self.symbol, ctx, grad_req=self.grad_req,
                                      type_dict=type_dict, shared_exec=shared_exec,
                                      **kwargs)
-        # replicate params over the mesh, shard data args
+        # shard data args on the mesh; params replicate (or shard on the
+        # model axis under tensor parallelism), grads/aux follow their param
         for name, arr in exec_.arg_dict.items():
-            self._place(arr, sharded=name in self.data_names or name in self.label_names)
-        for arr in exec_.aux_dict.values():
-            self._place(arr, sharded=False)
-        for arr in exec_.grad_dict.values():
-            self._place(arr, sharded=False)
+            self._place(arr, sharded=name in self.data_names
+                        or name in self.label_names, name=name)
+        for name, arr in exec_.aux_dict.items():
+            self._place(arr, sharded=False, name=name)
+        for name, arr in exec_.grad_dict.items():
+            self._place(arr, sharded=False, name=name)
         self.execs = [exec_]
         self.exec_ = exec_
         self.data_arrays = [exec_.arg_dict[n] for n in self.data_names]
@@ -161,17 +205,19 @@ class DataParallelExecutorGroup:
                       self.param_names, self.for_training, self.inputs_need_grad,
                       shared_group=shared,
                       fixed_param_names=self.fixed_param_names,
-                      grad_req=self.grad_req)
+                      grad_req=self.grad_req, mesh_config=self.mesh_config)
 
     def set_params(self, arg_params, aux_params):
         for name, arr in arg_params.items():
             if name in self.exec_.arg_dict:
                 arr.copyto(self.exec_.arg_dict[name])
-                self._place(self.exec_.arg_dict[name], sharded=False)
+                self._place(self.exec_.arg_dict[name], sharded=False,
+                            name=name)
         for name, arr in (aux_params or {}).items():
             if name in self.exec_.aux_dict:
                 arr.copyto(self.exec_.aux_dict[name])
-                self._place(self.exec_.aux_dict[name], sharded=False)
+                self._place(self.exec_.aux_dict[name], sharded=False,
+                            name=name)
 
     def get_params(self, arg_params, aux_params):
         for name in self.param_names:
@@ -202,11 +248,13 @@ class DataParallelExecutorGroup:
         nil."""
         if self._mesh is None:
             return
-        for arr in self.param_arrays + self.aux_arrays:
-            self._place(arr, sharded=False)
-        for arr in self.grad_arrays + self.input_grad_arrays:
+        for name, arr in zip(self.param_names + self.aux_names,
+                             self.param_arrays + self.aux_arrays):
+            self._place(arr, sharded=False, name=name)
+        for name, arr in zip(self.param_names + self.data_names,
+                             self.grad_arrays + self.input_grad_arrays):
             if arr is not None:
-                self._place(arr, sharded=False)
+                self._place(arr, sharded=False, name=name)
 
     def forward(self, data_batch, is_train=None):
         self.load_data_batch(data_batch)

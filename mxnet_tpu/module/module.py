@@ -22,8 +22,12 @@ from .executor_group import DataParallelExecutorGroup
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None, state_names=None, compute_dtype=None):
+                 fixed_param_names=None, state_names=None, compute_dtype=None,
+                 mesh_config=None):
         super().__init__(logger=logger)
+        # multi-axis parallelism over the bound contexts (parallel.MeshConfig:
+        # data/model/pipe/seq/expert); None = pure data parallel
+        self._mesh_config = mesh_config
         if compute_dtype is None:
             from .. import config as _config
 
@@ -230,7 +234,7 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group, logger=self.logger,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
-            state_names=self._state_names)
+            state_names=self._state_names, mesh_config=self._mesh_config)
         self._total_exec_bytes = 0
 
         if shared_module is not None:

@@ -1,0 +1,136 @@
+"""Tensor parallelism over the 'model' mesh axis (virtual 8-CPU mesh).
+
+Leapfrogs the reference (SURVEY §2.5: "Tensor/expert parallelism: not
+present"): FullyConnected/Convolution weights are annotated with
+model-axis shardings and GSPMD inserts the collectives.  These tests prove
+the (data x model) mesh computes the same numbers as one device.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.parallel import MeshConfig
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _convnet():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                          name="conv1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=4, name="fc")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _two_modules(net, data_shape, label_shape, mesh_config):
+    """(single-device module, mesh module) with identical params."""
+    mod1 = mx.mod.Module(net, context=mx.cpu(0))
+    mod1.bind(data_shapes=[("data", data_shape)],
+              label_shapes=[("softmax_label", label_shape)])
+    mod1.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    arg_params, aux_params = mod1.get_params()
+
+    modN = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                         mesh_config=mesh_config)
+    modN.bind(data_shapes=[("data", data_shape)],
+              label_shapes=[("softmax_label", label_shape)])
+    modN.init_params(arg_params=arg_params, aux_params=aux_params)
+    return mod1, modN
+
+
+def test_tp_mesh_shape():
+    net = _mlp()
+    modN = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                         mesh_config=MeshConfig(data=4, model=2))
+    modN.bind(data_shapes=[("data", (8, 10))],
+              label_shapes=[("softmax_label", (8,))])
+    mesh = modN._exec_group._mesh
+    assert dict(mesh.shape)["data"] == 4
+    assert dict(mesh.shape)["model"] == 2
+    modN.init_params(mx.initializer.One())
+    # fc1 weight (16, 10): dim0 sharded over model axis
+    w = modN._exec_group.exec_.arg_dict["fc1_weight"].data
+    spec = w.sharding.spec
+    assert spec[0] == "model", spec
+
+
+def test_tp_forward_matches_single_device():
+    net = _mlp()
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 10).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.float32)
+    mod1, modN = _two_modules(net, (8, 10), (8,),
+                              MeshConfig(data=4, model=2))
+    batch = DataBatch([nd.array(X)], [nd.array(y)])
+    mod1.forward(batch, is_train=False)
+    modN.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod1.get_outputs()[0].asnumpy(),
+                               modN.get_outputs()[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tp_training_matches_single_device():
+    """Several fit epochs on (data=4, model=2) produce the same weights as
+    one device."""
+    net = _convnet()
+    rng = np.random.RandomState(2)
+    X = rng.randn(16, 3, 8, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+    it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=8)
+
+    mod1, modN = _two_modules(net, (8, 3, 8, 8), (8,),
+                              MeshConfig(data=4, model=2))
+    for mod in (mod1, modN):
+        it.reset()
+        mod.fit(it, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                num_epoch=3, initializer=None,
+                arg_params=mod.get_params()[0],
+                aux_params=mod.get_params()[1])
+    p1, _ = mod1.get_params()
+    pN, _ = modN.get_params()
+    for name in p1:
+        np.testing.assert_allclose(p1[name].asnumpy(), pN[name].asnumpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_tp_pure_model_axis():
+    """model=8, data=1: pure tensor parallelism still matches."""
+    net = _mlp()
+    rng = np.random.RandomState(5)
+    X = rng.randn(4, 10).astype(np.float32)
+    y = rng.randint(0, 4, 4).astype(np.float32)
+    mod1, modN = _two_modules(net, (4, 10), (4,),
+                              MeshConfig(data=1, model=8))
+    batch = DataBatch([nd.array(X)], [nd.array(y)])
+    for mod in (mod1, modN):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+    np.testing.assert_allclose(mod1.get_outputs()[0].asnumpy(),
+                               modN.get_outputs()[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tp_survives_reshape():
+    """Module.reshape keeps the mesh_config (model axis intact)."""
+    net = _mlp()
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                        mesh_config=MeshConfig(data=1, model=8))
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.One())
+    mod.reshape([("data", (2, 10))], [("softmax_label", (2,))])
+    mesh = mod._exec_group._mesh
+    assert dict(mesh.shape)["model"] == 8
