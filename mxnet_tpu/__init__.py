@@ -20,6 +20,7 @@ from .context import Context, cpu, gpu, tpu, current_context, num_devices
 from . import attrs
 from . import registry
 from . import ops  # registers all operators
+from . import operator  # registers the Custom user-op framework
 from . import ndarray
 from . import ndarray as nd
 from . import random

@@ -1,0 +1,202 @@
+"""User-defined operators (``mx.operator``).
+
+Capability parity with the reference's Custom op stack
+(`python/mxnet/operator.py` + `src/operator/custom/custom.cc`): users
+subclass :class:`CustomOp` (imperative ``forward``/``backward`` over
+NDArrays) and :class:`CustomOpProp` (shape/type inference + operator
+construction), register the prop under a name, and use the op as
+``mx.sym.Custom(..., op_type=name)`` or ``mx.nd.Custom(...)``.
+
+TPU-native execution: the user's Python runs on the host through
+``jax.pure_callback`` — the analog of the reference routing Custom through
+``FnProperty::kAsync`` engine ops so arbitrary Python can block without
+stalling the device — and a ``jax.custom_vjp`` pairs the user's backward
+with XLA's autodiff, so Custom nodes compose with jit/vjp exactly like
+built-in ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .registry import OpDef, register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_CUSTOM_PROPS = {}
+
+# attrs handled by the framework, never forwarded to the user's prop
+_SYSTEM_KEYS = ("op_type", "ctx_group")
+
+
+class CustomOp:
+    """Base for user ops.  Subclasses implement ``forward`` and (when the
+    op participates in training) ``backward``; both receive NDArray lists
+    and write results with :meth:`assign`."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise MXNetError("%s does not implement backward"
+                         % type(self).__name__)
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request."""
+        if req in ("null", 0):
+            return
+        if req in ("add", "add_to", 3):
+            dst[:] = dst + src
+        else:  # write / inplace
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Declares a custom op's signature: argument/output names, shape and
+    dtype inference, and the operator factory."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``reg_name``."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(attrs):
+    """Instantiate the registered prop from a Custom node's attrs."""
+    op_type = attrs.get("op_type")
+    if not op_type:
+        raise MXNetError("Custom requires op_type=<registered name>")
+    prop_cls = _CUSTOM_PROPS.get(op_type)
+    if prop_cls is None:
+        raise MXNetError("Custom op %r is not registered (have: %s)"
+                         % (op_type, sorted(_CUSTOM_PROPS)))
+    kwargs = {k: v for k, v in
+              (attrs.items() if hasattr(attrs, "items") else [])
+              if k not in _SYSTEM_KEYS and not k.startswith("__")}
+    return prop_cls(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# the Custom OpDef: host callbacks under custom_vjp
+# ---------------------------------------------------------------------------
+
+def _wrap(host_arrays):
+    """numpy -> NDArray views for the user's imperative code."""
+    from . import ndarray as nd
+
+    return [nd.array(a) for a in host_arrays]
+
+
+def _custom_fcompute(attrs, inputs, aux, octx):
+    import jax
+    import jax.numpy as jnp
+
+    prop = get_prop(attrs)
+    if prop.list_auxiliary_states():
+        raise MXNetError("Custom aux states are not supported on the "
+                         "jit path; keep state inside the CustomOp")
+    in_shapes = [tuple(v.shape) for v in inputs]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    in_types = [np.dtype(v.dtype) for v in inputs]
+    _, out_types, _ = prop.infer_type(list(in_types))
+    out_struct = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                       for s, t in zip(out_shapes, out_types))
+    in_struct = tuple(jax.ShapeDtypeStruct(s, t)
+                      for s, t in zip(in_shapes, in_types))
+    op = prop.create_operator("cpu", [list(s) for s in in_shapes], in_types)
+    is_train = bool(octx.is_train)
+    n_out = len(out_struct)
+
+    def host_forward(*host_ins):
+        in_data = _wrap(host_ins)
+        out_data = _wrap([np.zeros(s.shape, s.dtype) for s in out_struct])
+        op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+        return tuple(o.asnumpy() for o in out_data)
+
+    def host_backward(*host_args):
+        k = len(inputs)
+        ins = list(host_args[:k])
+        outs = list(host_args[k:k + n_out])
+        cts = list(host_args[k + n_out:])
+        in_data = _wrap(ins)
+        out_data = _wrap(outs)
+        out_grad = _wrap(cts)
+        in_grad = _wrap([np.zeros_like(a) for a in ins])
+        op.backward(["write"] * k, out_grad, in_data, out_data, in_grad, [])
+        return tuple(g.asnumpy() for g in in_grad)
+
+    @jax.custom_vjp
+    def run(*ins):
+        return jax.pure_callback(host_forward, out_struct, *ins)
+
+    def run_fwd(*ins):
+        outs = jax.pure_callback(host_forward, out_struct, *ins)
+        return outs, (ins, outs)
+
+    def run_bwd(residual, cts):
+        ins, outs = residual
+        grads = jax.pure_callback(host_backward, in_struct,
+                                  *(tuple(ins) + tuple(outs) + tuple(cts)))
+        return tuple(grads)
+
+    run.defvjp(run_fwd, run_bwd)
+    return list(run(*inputs)), list(aux)
+
+
+def _custom_infer_shape(attrs, in_shapes, aux_shapes):
+    prop = get_prop(attrs)
+    ins, outs, aux = prop.infer_shape([list(s) if s else s
+                                       for s in in_shapes])
+    return [tuple(s) for s in ins], [tuple(s) for s in outs], \
+        [tuple(s) for s in (aux or [])]
+
+
+def _custom_n_inputs(attrs):
+    return len(get_prop(attrs).list_arguments())
+
+
+def _custom_n_outputs(attrs):
+    return len(get_prop(attrs).list_outputs())
+
+
+register_op(OpDef(
+    "Custom", _custom_fcompute,
+    num_inputs=_custom_n_inputs, num_outputs=_custom_n_outputs,
+    arguments=lambda a: get_prop(a).list_arguments(),
+    outputs=lambda a: get_prop(a).list_outputs(),
+    infer_shape=_custom_infer_shape, needs_train=True, hint="custom",
+    doc="User-defined Python operator; forward/backward run on the host "
+        "via pure_callback under a custom_vjp "
+        "(ref: src/operator/custom/custom.cc, python/mxnet/operator.py)."))

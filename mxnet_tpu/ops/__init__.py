@@ -6,7 +6,8 @@ init / ordering / control / softmax), nn layer ops, sampling, fused
 optimizer updates.  Contrib (detection / CTC / fft) and RNN register from
 their own modules as they land.
 """
-from . import elemwise, tensor, nn, sample, optimizer_ops, rnn_op
+from . import (elemwise, tensor, nn, sample, optimizer_ops, rnn_op, spatial,
+               contrib_ops)
 
 _registered = False
 
@@ -22,6 +23,8 @@ def register_all():
     sample.register_all()
     optimizer_ops.register_all()
     rnn_op.register_all()
+    spatial.register_all()
+    contrib_ops.register_all()
 
 
 register_all()

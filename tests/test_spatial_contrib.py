@@ -1,0 +1,446 @@
+"""Spatial + contrib operator tests (numpy references + finite differences).
+
+Reference analogs: tests/python/unittest/test_operator.py (ROIPooling,
+SpatialTransformer, BilinearSampler, GridGenerator, Crop, Correlation) and
+the contrib op tests (CTC, MultiBox*, fft, quantize).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+rng = np.random.RandomState(42)
+
+
+# -- ROIPooling --------------------------------------------------------------
+
+def _np_roi_pool(data, rois, pooled, scale):
+    n_rois = rois.shape[0]
+    c = data.shape[1]
+    ph, pw = pooled
+    out = np.zeros((n_rois, c, ph, pw), np.float32)
+    for r in range(n_rois):
+        b, x1, y1, x2, y2 = rois[r]
+        # C round(): half away from zero, matching roi_pooling.cc
+        x1, y1, x2, y2 = [int(np.trunc(v * scale + np.copysign(0.5, v * scale)))
+                          for v in (x1, y1, x2, y2)]
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        img = data[int(b)]
+        for i in range(ph):
+            for j in range(pw):
+                ys = y1 + (i * rh) // ph
+                ye = y1 + -((-(i + 1) * rh) // ph)
+                xs = x1 + (j * rw) // pw
+                xe = x1 + -((-(j + 1) * rw) // pw)
+                ys2, ye2 = np.clip([ys, ye], 0, data.shape[2])
+                xs2, xe2 = np.clip([xs, xe], 0, data.shape[3])
+                patch = img[:, ys2:ye2, xs2:xe2]
+                if patch.size:
+                    out[r, :, i, j] = patch.max(axis=(1, 2))
+    return out
+
+
+def test_roi_pooling_forward():
+    data = rng.rand(2, 3, 12, 12).astype(np.float32)
+    rois = np.array([[0, 0, 0, 11, 11],
+                     [1, 2, 2, 9, 9],
+                     [0, 4, 4, 7, 7]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(4, 4), spatial_scale=1.0).asnumpy()
+    want = _np_roi_pool(data, rois, (4, 4), 1.0)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_roi_pooling_grad():
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    net = sym.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=0.5)
+    # max-pool finite differences are tie-fragile: use well-separated
+    # values (a shuffled arange) so +-eps/2 never flips an argmax
+    local = np.random.RandomState(0)
+    vals = local.permutation(128).astype(np.float32).reshape(1, 2, 8, 8)
+    vals /= 128.0  # gaps of 1/128 >> eps, magnitudes small enough for f32 FD
+    check_numeric_gradient(
+        net, {"data": vals,
+              "rois": np.array([[0, 0, 0, 13, 13]], np.float32)},
+        grad_nodes=["data"], numeric_eps=1e-3, rtol=0.05, atol=0.02)
+
+
+# -- SpatialTransformer family ----------------------------------------------
+
+def test_spatial_transformer_identity():
+    data = rng.rand(2, 3, 6, 6).astype(np.float32)
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = nd.SpatialTransformer(nd.array(data), nd.array(loc),
+                                target_shape=(6, 6)).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_spatial_transformer_grad():
+    data = sym.Variable("data")
+    loc = sym.Variable("loc")
+    net = sym.SpatialTransformer(data, loc, target_shape=(4, 4))
+    theta = np.tile(np.array([0.8, 0.1, 0.05, -0.1, 0.9, 0.02], np.float32),
+                    (1, 1))
+    check_numeric_gradient(
+        net, {"data": rng.rand(1, 2, 5, 5).astype(np.float32), "loc": theta},
+        numeric_eps=1e-3, rtol=0.05, atol=0.02)
+
+
+def test_grid_generator_affine_plus_sampler_matches_st():
+    data = rng.rand(2, 3, 5, 5).astype(np.float32)
+    theta = rng.uniform(-0.2, 0.2, (2, 6)).astype(np.float32)
+    theta[:, 0] += 1.0
+    theta[:, 4] += 1.0
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(5, 5))
+    sampled = nd.BilinearSampler(nd.array(data), grid).asnumpy()
+    st = nd.SpatialTransformer(nd.array(data), nd.array(theta),
+                               target_shape=(5, 5)).asnumpy()
+    np.testing.assert_allclose(sampled, st, atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow_identity():
+    data = rng.rand(1, 2, 4, 4).astype(np.float32)
+    flow = np.zeros((1, 2, 4, 4), np.float32)
+    grid = nd.GridGenerator(nd.array(flow), transform_type="warp")
+    out = nd.BilinearSampler(nd.array(data), grid).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_bilinear_sampler_out_of_range_zero():
+    data = np.ones((1, 1, 4, 4), np.float32)
+    grid = np.full((1, 2, 2, 2), 3.0, np.float32)  # far outside [-1,1]
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, 0.0)
+
+
+# -- Crop / Correlation ------------------------------------------------------
+
+def test_crop():
+    data = rng.rand(1, 2, 8, 8).astype(np.float32)
+    out = nd.Crop(nd.array(data), num_args=1, offset=(1, 2),
+                  h_w=(4, 5)).asnumpy()
+    np.testing.assert_array_equal(out, data[:, :, 1:5, 2:7])
+    out2 = nd.Crop(nd.array(data), num_args=1, h_w=(4, 4),
+                   center_crop=True).asnumpy()
+    np.testing.assert_array_equal(out2, data[:, :, 2:6, 2:6])
+
+
+def test_crop_like():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    net = sym.Crop(a, b, num_args=2, name="crop")
+    ex = net.bind(mx.cpu(), {"a": nd.array(rng.rand(1, 2, 8, 8)),
+                             "b": nd.array(rng.rand(1, 2, 3, 3))})
+    assert ex.forward()[0].shape == (1, 2, 3, 3)
+
+
+def test_correlation_self_identity():
+    # correlating a map with itself at zero displacement = mean of squares
+    data = rng.rand(1, 4, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(data), nd.array(data),
+                         max_displacement=1).asnumpy()
+    assert out.shape == (1, 9, 6, 6)
+    center = out[0, 4]  # (dy, dx) == (0, 0)
+    np.testing.assert_allclose(center, (data[0] ** 2).mean(axis=0),
+                               rtol=1e-5)
+
+
+# -- CTC ---------------------------------------------------------------------
+
+def _np_ctc_single(logp, labels):
+    """Brute-force alpha recursion for one sequence (blank=0)."""
+    ext = []
+    for l in labels:
+        ext += [0, int(l)]
+    ext.append(0)
+    s = len(ext)
+    t_len = logp.shape[0]
+    alpha = np.full((t_len, s), -np.inf)
+    alpha[0, 0] = logp[0, ext[0]]
+    if s > 1:
+        alpha[0, 1] = logp[0, ext[1]]
+    for t in range(1, t_len):
+        for i in range(s):
+            cands = [alpha[t - 1, i]]
+            if i >= 1:
+                cands.append(alpha[t - 1, i - 1])
+            if i >= 2 and ext[i] != 0 and ext[i] != ext[i - 2]:
+                cands.append(alpha[t - 1, i - 2])
+            alpha[t, i] = np.logaddexp.reduce(cands) + logp[t, ext[i]]
+    return -np.logaddexp(alpha[-1, -1], alpha[-1, -2])
+
+
+def test_ctc_loss_matches_bruteforce():
+    t_len, batch, alphabet, l_len = 6, 3, 5, 2
+    acts = rng.randn(t_len, batch, alphabet).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0], [4, 4]], np.float32)
+    out = nd.CTCLoss(nd.array(acts), nd.array(labels)).asnumpy()
+    logp = acts - np.log(np.exp(acts).sum(-1, keepdims=True))
+    for b in range(batch):
+        lab = [int(v) for v in labels[b] if v > 0]
+        want = _np_ctc_single(logp[:, b], lab)
+        np.testing.assert_allclose(out[b], want, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_grad_finite_diff():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    net = sym.MakeLoss(sym.sum(sym.CTCLoss(data, label)))
+    acts = rng.randn(4, 2, 4).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0]], np.float32)
+    ex = net.bind(mx.cpu(), {"data": nd.array(acts),
+                             "label": nd.array(labels)},
+                  args_grad={"data": nd.zeros(acts.shape)},
+                  grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    eps = 1e-2
+    for idx in [(0, 0, 1), (2, 1, 3), (3, 0, 0)]:
+        pert = acts.copy()
+        pert[idx] += eps / 2
+        hi = nd.CTCLoss(nd.array(pert), nd.array(labels)).asnumpy().sum()
+        pert[idx] -= eps
+        lo = nd.CTCLoss(nd.array(pert), nd.array(labels)).asnumpy().sum()
+        np.testing.assert_allclose(g[idx], (hi - lo) / eps, rtol=0.05,
+                                   atol=0.01)
+
+
+# -- MultiBox / Proposal -----------------------------------------------------
+
+def test_multibox_prior():
+    data = nd.zeros((1, 3, 4, 4))
+    out = nd.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                           ratios=(1.0, 2.0)).asnumpy()
+    assert out.shape == (1, 4 * 4 * 3, 4)
+    # first cell, first anchor: size .5 centered at (.125, .125)
+    np.testing.assert_allclose(out[0, 0], [0.125 - 0.25, 0.125 - 0.25,
+                                           0.125 + 0.25, 0.125 + 0.25],
+                               atol=1e-6)
+
+
+def test_multibox_target_and_detection_roundtrip():
+    anchors = nd.MultiBoxPrior(nd.zeros((1, 3, 4, 4)), sizes=(0.4,))
+    gt = np.array([[[0, 0.1, 0.1, 0.4, 0.4],
+                    [1, 0.6, 0.6, 0.9, 0.9],
+                    [-1, 0, 0, 0, 0]]], np.float32)
+    cls_preds = nd.zeros((1, 3, 16))
+    loc_t, loc_m, cls_t = nd.MultiBoxTarget(anchors, nd.array(gt), cls_preds)
+    cls_np = cls_t.asnumpy()
+    assert (cls_np == 1).any() and (cls_np == 2).any()  # both gts matched
+    mask = loc_m.asnumpy()
+    assert mask.max() == 1.0 and mask.min() == 0.0
+
+    # perfect localization preds decode back onto the gt boxes
+    n_anchor = anchors.shape[1]
+    probs = np.zeros((1, 3, n_anchor), np.float32)
+    probs[0, 0] = 1.0  # background everywhere
+    matched = np.nonzero(cls_np[0])[0]
+    for a in matched:
+        probs[0, int(cls_np[0, a]), a] = 0.9
+        probs[0, 0, a] = 0.1
+    det = nd.MultiBoxDetection(nd.array(probs), loc_t.reshape((1, -1)),
+                               anchors).asnumpy()
+    kept = det[0][det[0, :, 0] >= 0]
+    assert len(kept) >= 2
+    for row in kept:
+        # decoded box should sit on one of the gt boxes
+        ious = []
+        for g in gt[0][gt[0, :, 0] >= 0]:
+            x1, y1, x2, y2 = row[2:6]
+            gx1, gy1, gx2, gy2 = g[1:5]
+            ix = max(0, min(x2, gx2) - max(x1, gx1))
+            iy = max(0, min(y2, gy2) - max(y1, gy1))
+            inter = ix * iy
+            union = (x2 - x1) * (y2 - y1) + (gx2 - gx1) * (gy2 - gy1) - inter
+            ious.append(inter / union)
+        assert max(ious) > 0.5
+
+
+def test_proposal_shapes_and_clip():
+    h = w = 4
+    k = 12  # 4 scales x 3 ratios
+    cls_prob = nd.array(rng.rand(1, 2 * k, h, w).astype(np.float32))
+    bbox_pred = nd.array(rng.randn(1, 4 * k, h, w).astype(np.float32) * 0.1)
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois = nd.Proposal(cls_prob, bbox_pred, im_info,
+                       rpn_post_nms_top_n=20).asnumpy()
+    assert rois.shape == (20, 5)
+    assert (rois[:, 1:] >= 0).all()
+    assert (rois[:, [1, 3]] <= 63).all() and (rois[:, [2, 4]] <= 63).all()
+
+
+# -- fft / quantize ----------------------------------------------------------
+
+def test_fft_ifft_roundtrip():
+    x = rng.randn(3, 8).astype(np.float32)
+    spec = nd.fft(nd.array(x))
+    assert spec.shape == (3, 16)
+    # interleaved packing matches numpy fft
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(spec.asnumpy()[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(spec.asnumpy()[:, 1::2], ref.imag, atol=1e-4)
+    # reference-convention ifft is unnormalized: scale by 1/d
+    back = nd.ifft(spec).asnumpy() / 8
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_quantize_dequantize():
+    x = rng.uniform(-3, 3, (4, 5)).astype(np.float32)
+    q, lo, hi = nd.quantize(nd.array(x), nd.array(-3.0), nd.array(3.0))
+    assert q.asnumpy().dtype == np.uint8
+    back = nd.dequantize(q, lo, hi).asnumpy()
+    np.testing.assert_allclose(back, x, atol=6 / 255 + 1e-6)
+
+
+# -- Custom op ---------------------------------------------------------------
+
+def test_custom_op_forward_backward():
+    import mxnet_tpu.operator as op_mod
+
+    @op_mod.register("sqr")
+    class SqrProp(op_mod.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Sqr(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                in_data[0] * in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                2.0 * in_data[0] * out_grad[0])
+            return Sqr()
+
+    x = rng.rand(3, 4).astype(np.float32)
+    # imperative
+    y = nd.Custom(nd.array(x), op_type="sqr").asnumpy()
+    np.testing.assert_allclose(y, x * x, rtol=1e-6)
+
+    # symbolic with gradient
+    data = sym.Variable("data")
+    net = sym.Custom(data, op_type="sqr", name="sqr")
+    ex = net.bind(mx.cpu(), {"data": nd.array(x)},
+                  args_grad={"data": nd.zeros(x.shape)})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, x * x, rtol=1e-6)
+    ex.backward(out_grads=nd.ones(x.shape))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-5)
+
+
+def test_custom_op_in_training_loop():
+    """Custom op composes with Module.fit (jit + vjp + optimizer)."""
+    import mxnet_tpu.operator as op_mod
+    from mxnet_tpu.io import NDArrayIter
+
+    @op_mod.register("scale2x")
+    class Scale2Prop(op_mod.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Scale2(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2.0)
+            return Scale2()
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Custom(net, op_type="scale2x", name="c")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    X = rng.randn(40, 6).astype(np.float32)
+    w = rng.randn(6).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier(), num_epoch=8)
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.8, acc
+
+
+def test_multibox_target_forced_match_with_padding():
+    """A gt whose best anchor is index 0 keeps its forced match even when
+    padding rows also argmax to anchor 0."""
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.2, 0.2],
+                                  [0.5, 0.5, 0.9, 0.9]]], np.float32))
+    # tiny gt overlapping anchor 0 with IoU below threshold + 2 pad rows
+    gt = np.array([[[0, 0.0, 0.0, 0.05, 0.05],
+                    [-1, 0, 0, 0, 0],
+                    [-1, 0, 0, 0, 0]]], np.float32)
+    _, _, cls_t = nd.MultiBoxTarget(anchors, nd.array(gt),
+                                    nd.zeros((1, 2, 2)),
+                                    overlap_threshold=0.5)
+    assert cls_t.asnumpy()[0, 0] == 1.0  # forced match survived
+
+
+def test_multibox_detection_per_class_nms():
+    """Default force_suppress=False keeps overlapping boxes of different
+    classes."""
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.12, 0.12, 0.52, 0.52]]], np.float32))
+    probs = np.array([[[0.1, 0.1], [0.9, 0.0], [0.0, 0.9]]], np.float32)
+    loc = nd.zeros((1, 8))
+    det = nd.MultiBoxDetection(nd.array(probs), loc, anchors).asnumpy()
+    kept_classes = sorted(det[0][det[0, :, 0] >= 0][:, 0].tolist())
+    assert kept_classes == [0.0, 1.0]
+    # force_suppress=True collapses them to one
+    det2 = nd.MultiBoxDetection(nd.array(probs), loc, anchors,
+                                force_suppress=True).asnumpy()
+    assert (det2[0, :, 0] >= 0).sum() == 1
+
+
+def test_multibox_prior_clip():
+    out = nd.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(0.9,),
+                           clip=True).asnumpy()
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+def test_crop_out_of_range_raises():
+    data = nd.ones((1, 2, 8, 8))
+    with pytest.raises(Exception):
+        nd.Crop(data, num_args=1, offset=(6, 6), h_w=(4, 4))
+
+
+def test_correlation_stride1():
+    data = nd.array(rng.rand(1, 4, 8, 8).astype(np.float32))
+    out = nd.Correlation(data, data, max_displacement=1, stride1=2)
+    assert out.shape == (1, 9, 4, 4)
+
+
+def test_proposal_min_size_scales_with_image():
+    """rpn_min_size is multiplied by im_info[2] (reference proposal.cc), so
+    a larger image scale filters more boxes and reorders the ranking."""
+    h = w = 4
+    k = 12
+    rs = np.random.RandomState(3)
+    cls_prob = nd.array(rs.rand(1, 2 * k, h, w).astype(np.float32))
+    bbox_pred = nd.array(rs.randn(1, 4 * k, h, w).astype(np.float32) * 0.2)
+    rois = {}
+    for scale in (1.0, 4.0):
+        rois[scale] = nd.Proposal(
+            cls_prob, bbox_pred,
+            nd.array(np.array([[64, 64, scale]], np.float32)),
+            rpn_post_nms_top_n=10, rpn_min_size=16).asnumpy()
+    # the rankings must differ, and at scale 4 the top-ranked (unfiltered,
+    # highest-score) box has min side >= 64; zero-score filtered boxes may
+    # still pad the tail, as in the reference
+    assert not np.allclose(rois[1.0], rois[4.0])
+    top = rois[4.0][0]
+    assert min(top[3] - top[1] + 1, top[4] - top[2] + 1) >= 64
