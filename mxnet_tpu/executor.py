@@ -467,7 +467,12 @@ class Executor:
             raise MXNetError("Cannot infer shapes with inputs %s" % kwargs)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
-        type_dict = type_dict or {}
+        # propagate dtypes through the graph from the (optional) type_dict
+        # seeds, so int inputs stay int and fp16/bf16 flows into weights
+        # instead of every buffer defaulting to float32
+        arg_types, _, aux_types = symbol.infer_type(**(type_dict or {}))
+        type_dict = dict(zip(arg_names, arg_types))
+        type_dict.update(zip(aux_names, aux_types))
         args = {}
         grads = {}
         if isinstance(grad_req, str):

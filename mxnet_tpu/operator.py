@@ -183,6 +183,13 @@ def _custom_infer_shape(attrs, in_shapes, aux_shapes):
         [tuple(s) for s in (aux or [])]
 
 
+def _custom_infer_type(attrs, in_types, aux_types):
+    prop = get_prop(attrs)
+    seed = [t if t is not None else np.dtype(np.float32) for t in in_types]
+    ins, outs, aux = prop.infer_type(seed)
+    return list(ins), list(outs), list(aux or aux_types)
+
+
 def _custom_n_inputs(attrs):
     return len(get_prop(attrs).list_arguments())
 
@@ -196,7 +203,8 @@ register_op(OpDef(
     num_inputs=_custom_n_inputs, num_outputs=_custom_n_outputs,
     arguments=lambda a: get_prop(a).list_arguments(),
     outputs=lambda a: get_prop(a).list_outputs(),
-    infer_shape=_custom_infer_shape, needs_train=True, hint="custom",
+    infer_shape=_custom_infer_shape, infer_type=_custom_infer_type,
+    needs_train=True, hint="custom",
     doc="User-defined Python operator; forward/backward run on the host "
         "via pure_callback under a custom_vjp "
         "(ref: src/operator/custom/custom.cc, python/mxnet/operator.py)."))

@@ -500,12 +500,14 @@ def register_all():
             "(ref: src/operator/contrib/ifft.cc)."),
         aliases=("_contrib_ifft",))
 
+    f32 = np.dtype(np.float32)
     register_op(OpDef(
         "quantize", simple_compute(_quantize, num_outputs=3),
         num_inputs=3, num_outputs=3,
         arguments=["data", "min_range", "max_range"],
         outputs=["output", "min_output", "max_output"],
         infer_shape=lambda a, i, x: (i, [i[0], (), ()], []),
+        infer_type=lambda a, i, x: (i, [np.dtype(np.uint8), f32, f32], x),
         hint="quantize",
         doc="uint8 range quantization "
             "(ref: src/operator/contrib/quantize.cc)."),
@@ -515,6 +517,7 @@ def register_all():
         "dequantize", simple_compute(_dequantize),
         num_inputs=3, arguments=["data", "min_range", "max_range"],
         infer_shape=lambda a, i, x: (i, [i[0]], []),
+        infer_type=lambda a, i, x: (i, [f32], x),
         hint="dequantize",
         doc="Inverse of quantize "
             "(ref: src/operator/contrib/dequantize.cc)."),

@@ -118,10 +118,20 @@ def register_all():
             return x.astype(jnp.bfloat16)
         return x.astype(np.dtype(dt))
 
+    def _cast_type(attrs, in_types, aux_types):
+        dt = attrs["dtype"]
+        if dt == "bfloat16":
+            import ml_dtypes
+
+            dt = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dt = np.dtype(dt)
+        return in_types, [dt], aux_types
+
     register_op(
         OpDef("Cast", simple_compute(_cast),
               schema=ParamSchema(Param("dtype", str, required=True)),
-              num_inputs=1, hint="cast"),
+              num_inputs=1, hint="cast", infer_type=_cast_type),
         aliases=["cast"],
     )
 

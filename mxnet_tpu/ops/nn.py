@@ -116,6 +116,14 @@ def _deconv_shape(attrs, in_shapes, aux_shapes):
     return shapes, [(n, nf, oh, ow)], []
 
 
+def _bn_type(attrs, in_types, aux_types):
+    """Output follows data; statistics (gamma/beta/mean/var + moving aux)
+    stay float32 for low-precision training (the cuDNN-BN convention)."""
+    f32 = np.dtype(np.float32)
+    d = in_types[0] if in_types[0] is not None else f32
+    return [d, f32, f32], [d, f32, f32], [f32, f32]
+
+
 def _bn_shape(attrs, in_shapes, aux_shapes):
     dshape = in_shapes[0]
     c = dshape[1]
@@ -440,7 +448,8 @@ def register_all():
         arguments=["data", "gamma", "beta"],
         outputs=["output", "mean", "var"],
         aux=["moving_mean", "moving_var"],
-        infer_shape=_bn_shape, needs_train=True, hint="batchnorm"))
+        infer_shape=_bn_shape, infer_type=_bn_type, needs_train=True,
+        hint="batchnorm"))
 
     # ---------------- Dropout ----------------
     def _dropout(attrs, inputs, aux, octx):

@@ -286,12 +286,21 @@ def register_all():
         out = tuple(dshape) + (attrs["output_dim"],)
         return [dshape, wshape], [out], []
 
+    def _embedding_type(attrs, in_types, aux_types):
+        # indices keep their own dtype (ints stay ints); output follows the
+        # weight table's dtype, defaulting to the op's dtype param
+        w = in_types[1] if in_types[1] is not None \
+            else np.dtype(attrs.get("dtype", "float32"))
+        d = in_types[0] if in_types[0] is not None else np.dtype(np.float32)
+        return [d, w], [w], aux_types
+
     register_op(OpDef("Embedding", simple_compute(_embedding),
                       schema=ParamSchema(Param("input_dim", int, required=True),
                                          Param("output_dim", int, required=True),
                                          Param("dtype", str, default="float32")),
                       num_inputs=2, arguments=["data", "weight"],
-                      infer_shape=_embedding_shape, hint="embedding"))
+                      infer_shape=_embedding_shape, hint="embedding",
+                      infer_type=_embedding_type))
 
     def _take(attrs, a, indices):
         return jnp.take(a, indices.astype(jnp.int32), axis=attrs.get("axis", 0),
@@ -316,12 +325,17 @@ def register_all():
             (attrs.get("on_value", 1.0) - attrs.get("off_value", 0.0)) + \
             attrs.get("off_value", 0.0)
 
+    def _one_hot_type(attrs, in_types, aux_types):
+        # output dtype comes from the op's dtype param, never the indices
+        return in_types, [np.dtype(attrs.get("dtype", "float32"))], aux_types
+
     register_op(OpDef("one_hot", simple_compute(_one_hot),
                       schema=ParamSchema(Param("depth", int, required=True),
                                          Param("on_value", float, default=1.0),
                                          Param("off_value", float, default=0.0),
                                          Param("dtype", str, default="float32")),
-                      num_inputs=1, arguments=["indices"]))
+                      num_inputs=1, arguments=["indices"],
+                      infer_type=_one_hot_type))
 
     def _pick(attrs, data, index):
         axis = attrs.get("axis", -1)

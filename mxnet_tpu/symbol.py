@@ -275,9 +275,18 @@ class Symbol:
         return arg_res, out_res, aux_res
 
     def infer_type(self, *args, **kwargs):
-        """Minimal dtype inference: float32 default, honoring __dtype__ attrs
-        and explicit dtype params (the executor re-derives real dtypes by
-        abstract evaluation at bind time)."""
+        """Propagate dtypes through the graph (nnvm InferType analog,
+        `graph_executor.cc:426`).
+
+        Unification semantics match the reference: an op's unresolved
+        variable inputs adopt the dtype promoted over its known inputs, so
+        declaring only ``data=float16`` types every downstream weight
+        float16 (the fp16/bf16 training pattern,
+        tests/python/train/test_dtype.py).  Ops with special typing (Cast,
+        Embedding, argmax/argsort, quantize, BatchNorm statistics) override
+        via their OpDef ``infer_type`` hook.  Returns (arg_types,
+        out_types, aux_types) as numpy dtypes.
+        """
         import numpy as np
 
         arg_names = self.list_arguments()
@@ -285,11 +294,81 @@ class Symbol:
         if args:
             for name, dt in zip(arg_names, args):
                 if dt is not None:
-                    known[name] = dt
-        known.update({k: v for k, v in kwargs.items() if v is not None})
-        arg_types = [known.get(n, np.float32) for n in arg_names]
-        out_types = [np.float32] * len(self._outputs)
-        aux_types = [np.float32] * len(self.list_auxiliary_states())
+                    known[name] = np.dtype(dt)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = np.dtype(v)
+
+        def promote(dts):
+            out = dts[0]
+            for d in dts[1:]:
+                out = np.promote_types(out, d)
+            return out
+
+        entry_t = {}       # (node id, out idx) -> dtype
+        var_t = {}         # variable name -> dtype (None = unresolved)
+        aux_t = {}
+        for node in self._topo():
+            if node.is_variable:
+                dt = known.get(node.name)
+                if dt is None and node.attrs.get("__dtype__"):
+                    dt = np.dtype(node.attrs["__dtype__"])
+                if node.is_aux_var:
+                    aux_t[node.name] = dt
+                else:
+                    var_t[node.name] = dt
+                entry_t[(id(node), 0)] = dt
+                continue
+            attrs = node.parsed_attrs()
+            n_args = node.op.n_inputs(attrs)
+            in_entries = node.inputs[:n_args]
+            aux_entries = node.inputs[n_args:]
+            in_types = [entry_t.get((id(s), i)) for s, i in in_entries]
+            aux_types = [entry_t.get((id(s), i)) for s, i in aux_entries]
+
+            fn = node.op.infer_type_fn
+            if fn is not None:
+                new_in, out_types, new_aux = fn(attrs, in_types, aux_types)
+            else:
+                # unify over *floating* inputs: integer index inputs
+                # (take/pick/batch_take) must neither promote the output to
+                # float64 nor type an unresolved weight as int.  An integer
+                # base only applies when every input is a resolved integer
+                # (genuinely integral ops).
+                resolved = [t for t in in_types if t is not None]
+                floats = [t for t in resolved if np.dtype(t).kind == "f"]
+                if floats:
+                    base = promote(floats)
+                elif resolved and len(resolved) == len(in_types):
+                    base = promote(resolved)
+                else:
+                    base = np.dtype(np.float32)
+                new_in = [t if t is not None else base for t in in_types]
+                out_types = [base] * node.op.n_outputs(attrs)
+                new_aux = [t if t is not None else base for t in aux_types]
+
+            # write resolved dtypes back into unresolved variables
+            for (src, i), t in zip(in_entries, new_in):
+                if t is None:
+                    continue
+                entry_t[(id(src), i)] = np.dtype(t)
+                if src.is_variable and var_t.get(src.name) is None:
+                    var_t[src.name] = np.dtype(t)
+            for (src, i), t in zip(aux_entries, new_aux or []):
+                if t is None:
+                    continue
+                entry_t[(id(src), i)] = np.dtype(t)
+                if src.is_variable and aux_t.get(src.name) is None:
+                    aux_t[src.name] = np.dtype(t)
+            for i, t in enumerate(out_types):
+                entry_t[(id(node), i)] = np.dtype(t) if t is not None else None
+
+        f32 = np.dtype(np.float32)
+        arg_types = [var_t.get(n) or f32 for n in arg_names]
+        out_types = [entry_t.get((id(n), i)) or f32
+                     for n, i in self._outputs]
+        aux_types = [aux_t.get(n) or f32
+                     for n in self.list_auxiliary_states()]
         return arg_types, out_types, aux_types
 
     # -- serialization -----------------------------------------------------

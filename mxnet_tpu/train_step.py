@@ -125,8 +125,12 @@ class CompiledTrainStep:
                 g = grads[i].astype(params[n].dtype)
                 w, s = opt_apply(params[n], g, slots[n],
                                  lrs[i], wds[i], rescale, clip, extra)
-                new_params[n] = w
-                new_slots[n] = s
+                # float32 hyper scalars promote fp16/bf16 masters; cast the
+                # update back so param dtypes are stable across steps
+                new_params[n] = w.astype(params[n].dtype)
+                new_slots[n] = tuple(
+                    s_new.astype(s_old.dtype)
+                    for s_new, s_old in zip(s, slots[n]))
             new_aux = {n: v.astype(aux[n].dtype)
                        for n, v in zip(aux_names, new_aux_vals)}
             return new_params, new_slots, new_aux, outs

@@ -1,0 +1,145 @@
+"""Dtype inference and low-precision training.
+
+Reference analogs: MXSymbolInferType (`graph_executor.cc:426`) and
+tests/python/train/test_dtype.py (fp16 CIFAR training).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import NDArrayIter
+
+
+def _convnet():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                          name="conv1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=4, name="fc")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_infer_type_default_float32():
+    net = _convnet()
+    arg_types, out_types, aux_types = net.infer_type()
+    assert all(t == np.float32 for t in arg_types)
+    assert all(t == np.float32 for t in out_types)
+    assert all(t == np.float32 for t in aux_types)
+
+
+def test_infer_type_propagates_fp16():
+    """Declaring only the data dtype types every connected weight (the
+    reference's fp16 training pattern)."""
+    net = _convnet()
+    arg_types, out_types, aux_types = net.infer_type(data=np.float16)
+    named = dict(zip(net.list_arguments(), arg_types))
+    assert named["conv1_weight"] == np.float16
+    assert named["fc_weight"] == np.float16
+    # BatchNorm statistics stay float32 regardless of compute dtype
+    assert named["bn1_gamma"] == np.float32
+    assert all(t == np.float32 for t in aux_types)
+
+
+def test_infer_type_embedding_indices_stay_int():
+    data = sym.Variable("data")
+    out = sym.Embedding(data, input_dim=50, output_dim=8, name="embed")
+    arg_types, out_types, _ = out.infer_type(data=np.int32)
+    named = dict(zip(out.list_arguments(), arg_types))
+    assert named["data"] == np.int32          # not unified with the table
+    assert named["embed_weight"] == np.float32
+    assert out_types[0] == np.float32
+
+
+def test_infer_type_cast():
+    data = sym.Variable("data")
+    out = sym.Cast(data, dtype="float64")
+    _, out_types, _ = out.infer_type(data=np.float32)
+    assert out_types[0] == np.float64
+
+
+def test_simple_bind_honors_type_dict():
+    net = _convnet()
+    ex = net.simple_bind(mx.cpu(), type_dict={"data": np.float16},
+                         data=(2, 3, 8, 8), softmax_label=(2,))
+    assert ex.arg_dict["data"].dtype == np.float16
+    assert ex.arg_dict["conv1_weight"].dtype == np.float16
+    assert ex.arg_dict["bn1_gamma"].dtype == np.float32
+    assert ex.aux_dict["bn1_moving_mean"].dtype == np.float32
+    # gradients allocated in the parameter's dtype
+    assert ex.grad_dict["conv1_weight"].dtype == np.float16
+
+
+def test_simple_bind_int_labels():
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=3,
+                                               name="fc"), name="softmax")
+    ex = net.simple_bind(mx.cpu(), type_dict={"softmax_label": np.int32},
+                         data=(4, 5), softmax_label=(4,))
+    assert ex.arg_dict["softmax_label"].dtype == np.int32
+
+
+def test_low_precision_training_end_to_end():
+    """Train the conv net with float16 parameters to high accuracy on a
+    separable problem (test_dtype.py analog, bf16-class precision)."""
+    np.random.seed(11)  # Xavier draws from global np.random; pin the init
+    rng = np.random.RandomState(0)
+    n = 160
+    y = rng.randint(0, 4, n)
+    X = rng.randn(n, 3, 8, 8).astype(np.float32) * 0.1
+    for i in range(n):  # plant a strong class-dependent mean pattern
+        X[i, y[i] % 3, :, :] += 1.0 + y[i] * 0.5
+
+    net = _convnet()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (16, 3, 8, 8),
+                                         np.float16)],
+             label_shapes=[mx.io.DataDesc("softmax_label", (16,))])
+    mod.init_params(mx.initializer.Xavier())
+    assert mod._exec_group.exec_.arg_dict["conv1_weight"].dtype == np.float16
+    it = NDArrayIter({"data": X.astype(np.float16)},
+                     {"softmax_label": y.astype(np.float32)}, batch_size=16)
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=6, initializer=mx.initializer.Xavier(),
+            force_init=True)
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_infer_type_int_inputs_do_not_promote():
+    """Integer index inputs neither type unresolved weights int nor promote
+    float paths to float64 (reference unifies; it never promotes)."""
+    w = sym.Variable("w")
+    idx = sym.Variable("idx")
+    out = sym.take(w, idx)
+    arg_types, out_types, _ = out.infer_type(idx=np.int32)
+    named = dict(zip(out.list_arguments(), arg_types))
+    assert named["w"] == np.float32
+    assert out_types[0] == np.float32
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.pick(net, sym.Variable("index"))
+    _, out_types2, _ = net.infer_type(data=np.float32, index=np.int32)
+    assert out_types2[0] == np.float32  # not float64
+
+
+def test_infer_type_one_hot_uses_dtype_param():
+    label = sym.Variable("label")
+    net = sym.FullyConnected(sym.one_hot(label, depth=4), num_hidden=3,
+                             name="fc")
+    arg_types, _, _ = net.infer_type(label=np.int32)
+    named = dict(zip(net.list_arguments(), arg_types))
+    assert named["fc_weight"] == np.float32
+    assert named["label"] == np.int32
+
+
+def test_infer_type_quantize():
+    data = sym.Variable("data")
+    q = sym.quantize(data, sym.Variable("lo"), sym.Variable("hi"))
+    _, out_types, _ = q.infer_type(data=np.float32)
+    assert out_types[0] == np.uint8
