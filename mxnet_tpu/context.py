@@ -60,16 +60,24 @@ class Context:
     # -- JAX mapping ------------------------------------------------------
     @property
     def jax_device(self):
-        """The concrete ``jax.Device`` this context maps onto."""
+        """The concrete ``jax.Device`` this context maps onto.
+
+        Contexts address this process's devices: under multi-process
+        (jax.distributed) only local devices are addressable, so the lookup
+        is over ``local_devices`` — matching the reference, where each
+        worker's ``mx.gpu(i)`` is a local ordinal.
+        """
         import jax
 
+        multiproc = jax.process_count() > 1
         if self.device_type in ("cpu", "cpu_pinned"):
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu") if multiproc \
+                    else jax.devices("cpu")
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices() if multiproc else jax.devices()
         else:  # gpu / tpu → accelerator platform, fall back to default
-            devs = _accelerator_devices()
+            devs = _accelerator_devices(local=multiproc)
         return devs[self.device_id % len(devs)]
 
     def __enter__(self):
@@ -82,16 +90,19 @@ class Context:
         Context._state.stack.pop()
 
 
-def _accelerator_devices():
+def _accelerator_devices(local=False):
     """TPU devices, else whatever the default platform offers (CPU in tests)."""
     import jax
 
+    lister = jax.local_devices if local else jax.devices
     for plat in ("tpu", "axon"):
         try:
-            return jax.devices(plat)
+            devs = (lister(backend=plat) if local else lister(plat))
+            if devs:
+                return devs
         except RuntimeError:
             continue
-    return jax.devices()
+    return lister()
 
 
 def cpu(device_id=0):

@@ -1,22 +1,30 @@
 """KVStore server bootstrap (reference: python/mxnet/kvstore_server.py).
 
-The reference launches dedicated parameter-server processes
-(`DMLC_ROLE=server`) running a command loop with a pickled optimizer.  On
-TPU there is no parameter server: synchronization is XLA collectives inside
-the compiled step, and every process is a worker.  This module keeps the
-entry point so reference launch scripts don't crash: a 'server' role simply
-idles until the workers finish (join barrier), which we implement as a
-no-op return.
+DESCOPE (documented deviation): the reference launches dedicated
+parameter-server processes (`DMLC_ROLE=server`) running a ps-lite command
+loop that applies a pickled optimizer to pushed gradients
+(`src/kvstore/kvstore_dist_server.h`).  On TPU the parameter server has no
+role: gradient synchronization is XLA collectives (psum over ICI/DCN)
+inside the compiled train step, every process is a worker, and the
+optimizer runs worker-side on the already-reduced gradients — the
+`dist_sync` semantics without the extra hop.  This module keeps the
+reference's process contract so `tools/launch.py`-style cluster scripts
+work unchanged: a process started with DMLC_ROLE=server logs the
+explanation and exits cleanly at import (the reference similarly never
+returns control to the user script in server processes).
 """
 from __future__ import annotations
 
 import logging
 import os
+import sys
 
 __all__ = ["KVStoreServer"]
 
 
 class KVStoreServer:
+    """Compatibility shim for the reference server-process API."""
+
     def __init__(self, kvstore):
         self.kvstore = kvstore
         self.init_logging = False
@@ -27,9 +35,12 @@ class KVStoreServer:
 
 
 def _init_kvstore_server_module():
-    role = os.environ.get("DMLC_ROLE", "")
-    if role == "server":
+    if os.environ.get("DMLC_ROLE", "") == "server":
         from . import kvstore
 
-        server = KVStoreServer(kvstore.create("dist"))
-        server.run()
+        KVStoreServer(kvstore.create("dist")).run()
+        # the reference's server processes never run the user script body
+        sys.exit(0)
+
+
+_init_kvstore_server_module()

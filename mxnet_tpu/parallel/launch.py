@@ -30,6 +30,12 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
         process_id = int(os.environ.get("DMLC_WORKER_ID",
                                         os.environ.get("DMLC_RANK", "0")))
     if coordinator_address is not None:
+        try:
+            # CPU processes federate through gloo (TCP); TPU uses ICI and
+            # ignores this.  Must be set before the backend exists.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import json
 
+import numpy as np
+
 from .base import MXNetError, AttrScope, NameManager
 from . import registry as _reg
 
@@ -300,10 +302,23 @@ class Symbol:
                 known[k] = np.dtype(v)
 
         def promote(dts):
-            out = dts[0]
+            out = np.dtype(dts[0])
             for d in dts[1:]:
-                out = np.promote_types(out, d)
+                d = np.dtype(d)
+                if d == out:
+                    continue
+                try:
+                    out = np.promote_types(out, d)
+                except TypeError:
+                    # custom float (ml_dtypes bfloat16) mixed with another
+                    # float: numpy can't promote — widen to float32
+                    out = np.dtype(np.float32)
             return out
+
+        def floating(t):
+            dt = np.dtype(t)
+            # ml_dtypes bfloat16 registers with kind 'V'
+            return dt.kind == "f" or dt.name == "bfloat16"
 
         entry_t = {}       # (node id, out idx) -> dtype
         var_t = {}         # variable name -> dtype (None = unresolved)
@@ -336,7 +351,7 @@ class Symbol:
                 # base only applies when every input is a resolved integer
                 # (genuinely integral ops).
                 resolved = [t for t in in_types if t is not None]
-                floats = [t for t in resolved if np.dtype(t).kind == "f"]
+                floats = [t for t in resolved if floating(t)]
                 if floats:
                     base = promote(floats)
                 elif resolved and len(resolved) == len(in_types):
@@ -432,7 +447,10 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if wd_mult is not None:
         attr["__wd_mult__"] = str(wd_mult)
     if dtype is not None:
-        attr["__dtype__"] = str(dtype)
+        # normalize so infer_type can np.dtype() it back (np.float16 the
+        # class would stringify as "<class 'numpy.float16'>")
+        attr["__dtype__"] = dtype if isinstance(dtype, str) \
+            else np.dtype(dtype).name
     if init is not None:
         attr["__init__"] = init.dumps() if hasattr(init, "dumps") else str(init)
     attr.update(kwargs)

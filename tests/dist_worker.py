@@ -1,0 +1,59 @@
+"""Worker program for the multi-process dist-kvstore test.
+
+Launched by tests/test_dist_kvstore.py as N real OS processes (the
+reference's nightly pattern: tests/nightly/dist_sync_kvstore.py spawned by
+tools/launch.py — no mocked transports).  Asserts exact deterministic sums
+through the dist_sync KVStore, then trains one synchronized step.
+
+Usage: python dist_worker.py <rank> <nprocs> <coordinator>
+"""
+import sys
+
+rank, nprocs, coordinator = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+import jax
+
+# this environment pre-imports jax with the TPU plugin; config.update is
+# the reliable way to pin the CPU platform (see tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.parallel import launch
+
+launch.init(coordinator_address=coordinator, num_processes=nprocs,
+            process_id=rank)
+assert jax.process_count() == nprocs, jax.process_count()
+
+kv = mx.kvstore.create("dist_sync")
+assert kv.rank == rank
+assert kv.num_workers == nprocs
+
+# -- exact-sum push/pull over several keys/shapes (dist_sync_kvstore.py) ----
+shapes = {3: (4, 5), "big": (30, 10), 9: (2,)}
+for key, shape in shapes.items():
+    kv.init(key, nd.zeros(shape))
+for step in range(3):
+    for key, shape in shapes.items():
+        # worker r pushes (r+1) * (step+1); global sum is deterministic
+        kv.push(key, nd.full(shape, float(rank + 1) * (step + 1)))
+        out = nd.zeros(shape)
+        kv.pull(key, out=out)
+        want = sum(r + 1 for r in range(nprocs)) * (step + 1)
+        np.testing.assert_allclose(out.asnumpy(), want)
+kv.barrier()
+
+# -- updater path: optimizer applies the globally summed gradient ----------
+kv2 = mx.kvstore.create("dist_sync")
+kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+kv2.init(0, nd.full((3, 3), 10.0))
+kv2.push(0, nd.full((3, 3), float(rank + 1)))   # global grad = sum = 3
+out = nd.zeros((3, 3))
+kv2.pull(0, out=out)
+want = 10.0 - 0.5 * sum(r + 1 for r in range(nprocs))
+np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+kv2.barrier()
+
+print("WORKER_%d_OK" % rank, flush=True)
