@@ -7,9 +7,10 @@ while parameters live in ONE set of buffers shared through shared-module
 binding.
 
 Layout here: a ``_primary`` module (default bucket) owns params and the
-optimizer; ``_bucket_for`` lazily binds per-key modules against it.  All
-buckets run the eager update path — a per-bucket fused step would fork the
-master weights (see Module.borrow_optimizer).
+optimizer; ``switch_bucket`` lazily binds per-key modules against it.  All
+buckets share ONE fused-train-step master-weight store (each bucket gets a
+shape-specialized compiled program inside it), so variable-length LSTM/LM
+workloads train on the fused path, not a per-bucket eager fallback.
 """
 from __future__ import annotations
 
@@ -148,6 +149,7 @@ class BucketingModule(BaseModule):
                         shared_module=self._primary)
             if self.optimizer_initialized:
                 module.borrow_optimizer(self._primary)
+                self._ensure_fused_compat(module)
             self._by_key[bucket_key] = module
         self._active = module
         self._active_key = bucket_key
@@ -162,18 +164,45 @@ class BucketingModule(BaseModule):
         primary = self._primary
         primary.init_optimizer(kvstore, optimizer, optimizer_params,
                                force_init=force_init)
-        if primary._fused_step is not None:
-            # all buckets must share one update path; a fused step on the
-            # primary alone would fork the weights away from the shared
-            # executor buffers the other buckets read
-            primary._handoff_fused_to_eager()
-            primary._fused_step = None
+        # every bucket adopts the primary's update path — including its
+        # fused step when one compiled: the step is ONE master-weight store
+        # that compiles a per-bucket program on first use, so LSTM/LM
+        # workloads get the fused-path throughput on all buckets
         for module in self._by_key.values():
             if module is not primary:
                 module.borrow_optimizer(primary)
+                self._ensure_fused_compat(module)
         self.optimizer_initialized = True
 
+    def _ensure_fused_compat(self, module):
+        """Buckets whose parameter set is only partially shared with the
+        primary (shape-varying params get per-bucket storage, matching the
+        reference) cannot ride the shared fused store — demote ALL buckets
+        to the eager update path so every path sees one source of truth."""
+        primary = self._primary
+        step = primary._fused_step
+        if step is None or step.compatible(module._exec_group):
+            return
+        self.logger.info(
+            "bucket parameters are not fully shared with the primary; "
+            "using the eager update path for all buckets")
+        primary._handoff_fused_to_eager()
+        for m in self._by_key.values():
+            m._fused_step = None
+            m._opt_owner = "eager"
+        module._fused_step = None
+        module._opt_owner = "eager"
+
     # ------------------------------------------------------------------
+    def forward_backward(self, data_batch):
+        """Route training batches through the active bucket's own
+        ``forward_backward`` so each bucket reaches the shared fused step
+        (a plain forward+backward here would silently force eager)."""
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._active.forward_backward(data_batch)
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,

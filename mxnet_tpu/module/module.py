@@ -41,6 +41,10 @@ class Module(BaseModule):
         self._exec_stale = False          # step newer than executor arrays
         self._opt_owner = "eager"         # who holds live optimizer slots
         self._monitor = None
+        # NOTE: _step_stale/_exec_stale are properties delegating to the
+        # (possibly shared) fused step when one exists — several bucket
+        # modules can view one master-weight store, so staleness must live
+        # with the store, not the module
         if context is None:
             context = ctx_mod.cpu()
         if isinstance(context, ctx_mod.Context):
@@ -324,6 +328,45 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    @property
+    def _step_stale(self):
+        if self._fused_step is not None:
+            return self._fused_step.step_stale
+        return self.__dict__.get("_step_stale_local", False)
+
+    @_step_stale.setter
+    def _step_stale(self, value):
+        if getattr(self, "_fused_step", None) is not None:
+            self._fused_step.step_stale = value
+        self.__dict__["_step_stale_local"] = value
+
+    @property
+    def _exec_stale(self):
+        if self._fused_step is not None:
+            return self._fused_step.exec_stale
+        return self.__dict__.get("_exec_stale_local", False)
+
+    @_exec_stale.setter
+    def _exec_stale(self, value):
+        if getattr(self, "_fused_step", None) is not None:
+            self._fused_step.exec_stale = value
+        self.__dict__["_exec_stale_local"] = value
+
+    @property
+    def _opt_owner(self):
+        # like the staleness flags, slot ownership belongs to the (possibly
+        # shared) store: a fused->eager handoff by one bucket module must be
+        # visible to every other module viewing the same master weights
+        if self._fused_step is not None:
+            return self._fused_step.opt_owner
+        return self.__dict__.get("_opt_owner_local", "eager")
+
+    @_opt_owner.setter
+    def _opt_owner(self, value):
+        if getattr(self, "_fused_step", None) is not None:
+            self._fused_step.opt_owner = value
+        self.__dict__["_opt_owner_local"] = value
+
     def _fused_eligible(self, optimizer, kvstore):
         """Whether the fused (donated, jitted) train step can own the
         update: single-process kvstore, no monitor taps, optimizer with a
@@ -365,17 +408,22 @@ class Module(BaseModule):
                              "eager update path", exc)
 
     def borrow_optimizer(self, shared_module):
-        """Share optimizer state with another module (bucketing).  Bucketed
-        modules share parameter buffers through the executor, so they use the
-        eager update path (one fused step per bucket would fork the master
-        weights)."""
+        """Share optimizer state with another module (bucketing).
+
+        When the shared module owns a fused step, this module adopts the
+        SAME master-weight store — its own executor graph gets a
+        shape-specialized program inside that store on first run, so every
+        bucket trains through the fused path against one set of weights.
+        """
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
-        self._fused_step = None
-        self._opt_owner = "eager"
+        self._fused_step = shared_module._fused_step
+        if self._fused_step is None:
+            self._opt_owner = "eager"
+        # (with a shared step, _opt_owner reads the store's flag directly)
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------------
@@ -401,7 +449,7 @@ class Module(BaseModule):
                 self._fused_step.import_updater_states(
                     self._updater.states, self._exec_group.param_names)
             self._opt_owner = "fused"
-        outs = self._fused_step.run(data_batch)
+        outs = self._fused_step.run(data_batch, group=self._exec_group)
         ctx = self._context[0]
         self._fused_outputs = [_nd.NDArray(o, ctx) for o in outs]
         self._fused_update_done = True

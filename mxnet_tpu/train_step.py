@@ -32,6 +32,16 @@ __all__ = ["CompiledTrainStep"]
 
 
 class CompiledTrainStep:
+    """One master-weight store + per-executor-group compiled step programs.
+
+    Bucketed training shares a single instance across all bucket modules:
+    each bucket's shape-specialized executor gets its own jitted program
+    (``_entry_for``), but every program reads and donates the same
+    params/slots/aux dicts — the analog of the reference's shared memory
+    pools across bucket executors (bucketing_module.py:18-120) extended to
+    the fused update path.
+    """
+
     def __init__(self, exec_group, optimizer, compute_dtype=None):
         import jax.numpy as jnp
 
@@ -78,16 +88,64 @@ class CompiledTrainStep:
         self.aux = {n: jnp.copy(exe.aux_dict[n].data) for n in self._aux_names}
         self.slots = {n: self._make_slots(self.params[n])
                       for n in self._grad_names}
-        self._fn = self._build()
+        # compiled programs keyed by executor identity (the value holds a
+        # strong ref to the executor so a GC'd id can't alias a new one);
+        # a reshape rebuilds group.exec_, so the stale program is skipped
+        self._fns = {}
+        self._fn = self._build(exec_group)
+        self._fns[id(exec_group.exec_)] = (self._fn, exec_group.exec_)
         self.num_steps = 0
         self._hyper_cache = None
+        # lifecycle state is a property of the shared store, not of any one
+        # module (several bucket modules may view this step)
+        self.step_stale = False   # executor buffers newer than the store
+        self.exec_stale = False   # store newer than executor buffers
+        self.opt_owner = "eager"  # who holds live optimizer slots
+
+    def compatible(self, group):
+        """Whether a (bucket) executor group can train through this store.
+
+        Requires every master param/aux to be the *same shared buffer* as
+        the primary executor's (shared binding shares identity when shapes
+        match), and no extra trainable params.  Buckets with shape-varying
+        params (the reference lets those be per-bucket copies) must use the
+        eager path instead."""
+        exe = group.exec_
+        prim = self._exec
+        for n in self._param_names:
+            if exe.arg_dict.get(n) is not prim.arg_dict[n]:
+                return False
+        for n in self._aux_names:
+            if exe.aux_dict.get(n) is not prim.aux_dict[n]:
+                return False
+        data_like = set(group.data_names) | set(group.label_names)
+        for n in exe._arg_names:
+            if n not in data_like and n not in self._param_names:
+                return False
+        return True
+
+    def _entry_for(self, group):
+        """The compiled step program for a (bucket) executor group, built on
+        first use.  The group must expose the same parameter set — shared
+        binding guarantees it for BucketingModule."""
+        exe = group.exec_
+        hit = self._fns.get(id(exe))
+        if hit is not None and hit[1] is exe:
+            return hit[0]
+        if not self.compatible(group):
+            raise MXNetError(
+                "bucket executor's parameter set is not shared with the "
+                "master store; demote this bucket to the eager path")
+        fn = self._build(group)
+        self._fns[id(exe)] = (fn, exe)
+        return fn
 
     # ------------------------------------------------------------------
-    def _build(self):
+    def _build(self, group):
         import jax
         import jax.numpy as jnp
 
-        exe = self._exec
+        exe = group.exec_
         cdtype = self._cdtype
         data_names = self._data_names
         grad_names = self._grad_names
@@ -138,21 +196,29 @@ class CompiledTrainStep:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
-    def run(self, data_batch):
-        """Execute one full training step; returns output jnp arrays."""
+    def run(self, data_batch, group=None):
+        """Execute one full training step; returns output jnp arrays.
+
+        ``group`` selects the (bucket) executor whose graph to run; the
+        master weights/slots are this store's regardless.
+        """
         from . import random as _rnd
 
+        group = group if group is not None else self._group
+        fn = self._entry_for(group)
+        label_names = [n for n in group.label_names
+                       if n in group.exec_.arg_dict]
         data = {}
-        for name, arr in zip(self._data_names, data_batch.data):
-            data[name] = self._place(arr, name)
-        if self._label_names and data_batch.label:
+        for name, arr in zip(group.data_names, data_batch.data):
+            data[name] = self._place(arr, name, group)
+        if label_names and data_batch.label:
             # zip the *unfiltered* group label list so an unconsumed early
             # label cannot shift later labels onto the wrong arrays; names
             # the symbol doesn't take are skipped in-loop (same alignment
             # rule as DataParallelExecutorGroup.forward)
-            for name, arr in zip(self._group.label_names, data_batch.label):
-                if name in self._label_names:
-                    data[name] = self._place(arr, name)
+            for name, arr in zip(group.label_names, data_batch.label):
+                if name in label_names:
+                    data[name] = self._place(arr, name, group)
 
         lrs, wds, rescale, clip = self._optimizer.fused_hyper(self._grad_indices)
         extra = self._optimizer.fused_extra()
@@ -167,7 +233,6 @@ class CompiledTrainStep:
         else:
             import jax
 
-            group = self._group
             where = group._rep_sharding if group._mesh is not None \
                 else group.contexts[0].jax_device
             dev = tuple(jax.device_put(v, where)
@@ -175,17 +240,17 @@ class CompiledTrainStep:
             self._hyper_cache = (lrs, wds, rescale, clip, extra, dev)
             lrs, wds, rescale, clip, extra = dev
         rng = _rnd.split_key()
-        self.params, self.slots, self.aux, outs = self._fn(
+        self.params, self.slots, self.aux, outs = fn(
             self.params, self.slots, self.aux, data, lrs, wds, rescale, clip,
             extra, rng)
         self.num_steps += 1
         return outs
 
-    def _place(self, arr, name):
+    def _place(self, arr, name, group=None):
         import jax
 
-        group = self._group
-        dst = self._exec.arg_dict.get(name)
+        group = group if group is not None else self._group
+        dst = group.exec_.arg_dict.get(name)
         v = arr.data
         if dst is not None and v.dtype != dst.data.dtype:
             v = v.astype(dst.data.dtype)

@@ -8,6 +8,7 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
 from mxnet_tpu.io import DataBatch
 
 
@@ -220,3 +221,57 @@ def test_cross_format_state_load(tmp_path):
     np.testing.assert_allclose(
         np.asarray(fused._fused_step.slots["fc1_weight"][0]), m_fused,
         rtol=1e-6)
+
+
+def test_bucketing_shares_one_fused_store():
+    """All bucket modules train through ONE CompiledTrainStep (shared master
+    weights, per-bucket compiled programs) and learn across buckets."""
+    import numpy as np
+
+    from mxnet_tpu import rnn as rnn_mod
+
+    rng = np.random.RandomState(0)
+    sentences = []
+    for _ in range(300):
+        length = rng.randint(2, 8)
+        start = rng.randint(1, 40)
+        s = [start]
+        for _ in range(length - 1):
+            s.append((s[-1] * 31 + 7) % 40 or 1)
+        sentences.append(s)
+    it = rnn_mod.BucketSentenceIter(sentences, batch_size=16, buckets=[4, 8],
+                                    seed=0)
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=40, output_dim=12, name="embed")
+        cell = mx.rnn.LSTMCell(24, prefix="l0_")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = sym.FullyConnected(sym.Reshape(outputs, shape=(-1, 24)),
+                                  num_hidden=40, name="fc")
+        flat = sym.Reshape(label, shape=(-1,))
+        return sym.SoftmaxOutput(pred, flat, use_ignore=True,
+                                 ignore_label=-1, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.fit(it, optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(), num_epoch=6,
+            eval_metric=mx.metric.Perplexity(ignore_label=-1))
+
+    steps = {id(m._fused_step) for m in mod._buckets.values()
+             if m._fused_step is not None}
+    assert len(mod._buckets) >= 2          # both buckets were exercised
+    assert len(steps) == 1                 # ... through one shared store
+    store = next(iter(mod._buckets.values()))._fused_step
+    assert store is not None
+    assert len(store._fns) >= 2            # per-bucket compiled programs
+    assert store.num_steps > 0
+
+    # the trained model predicts the deterministic chain with low perplexity
+    metric = mx.metric.Perplexity(ignore_label=-1)
+    it.reset()
+    score = dict(mod.score(it, metric))
+    assert score["Perplexity"] < 3.0, score
