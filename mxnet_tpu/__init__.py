@@ -51,6 +51,8 @@ from . import module
 from . import module as mod
 from . import model
 from .model import FeedForward
+from . import predictor
+from .predictor import Predictor
 from . import rnn
 from . import parallel
 from . import profiler

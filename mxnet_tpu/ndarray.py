@@ -433,23 +433,33 @@ def save(fname, data):
 
 
 def load(fname):
+    """Load from a .params path or an in-memory ``bytes`` blob (the latter
+    serves the predict API, reference c_predict_api.h:59-77)."""
+    import io as _io
+
+    if isinstance(fname, (bytes, bytearray, memoryview)):
+        return _load_stream(_io.BytesIO(bytes(fname)), "<bytes>")
     with open(fname, "rb") as f:
-        magic = f.read(8)
-        if magic != _MAGIC:
-            raise MXNetError("Invalid NDArray file format: %s" % fname)
-        (count,) = struct.unpack("<q", f.read(8))
-        names, arrays = [], []
-        for _ in range(count):
-            (nlen,) = struct.unpack("<i", f.read(4))
-            name = f.read(nlen).decode()
-            (dlen,) = struct.unpack("<i", f.read(4))
-            dt = np.dtype(f.read(dlen).decode())
-            (ndim,) = struct.unpack("<i", f.read(4))
-            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
-            (rawlen,) = struct.unpack("<q", f.read(8))
-            buf = np.frombuffer(f.read(rawlen), dtype=dt).reshape(shape)
-            names.append(name)
-            arrays.append(array(buf, dtype=dt.type))
+        return _load_stream(f, fname)
+
+
+def _load_stream(f, fname):
+    magic = f.read(8)
+    if magic != _MAGIC:
+        raise MXNetError("Invalid NDArray file format: %s" % fname)
+    (count,) = struct.unpack("<q", f.read(8))
+    names, arrays = [], []
+    for _ in range(count):
+        (nlen,) = struct.unpack("<i", f.read(4))
+        name = f.read(nlen).decode()
+        (dlen,) = struct.unpack("<i", f.read(4))
+        dt = np.dtype(f.read(dlen).decode())
+        (ndim,) = struct.unpack("<i", f.read(4))
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+        (rawlen,) = struct.unpack("<q", f.read(8))
+        buf = np.frombuffer(f.read(rawlen), dtype=dt).reshape(shape)
+        names.append(name)
+        arrays.append(array(buf, dtype=dt.type))
     if any(names):
         return dict(zip(names, arrays))
     return arrays

@@ -1,0 +1,266 @@
+"""Standalone inference: symbol JSON + params -> jitted forward.
+
+TPU-native analog of the reference's C predict API
+(``c_predict_api.cc``, ``include/mxnet/c_predict_api.h:59-169``):
+
+==============================  =======================================
+reference                       here
+==============================  =======================================
+``MXPredCreate``                ``Predictor(symbol, params, shapes)``
+``MXPredCreatePartialOut``      ``Predictor(..., output_names=[...])``
+``MXPredReshape``               ``Predictor.reshape({...})``
+``MXPredSetInput/Forward``      ``Predictor.forward(**inputs)``
+``MXPredGetOutputShape``        ``Predictor.output_shapes``
+``MXPredGetOutput``             ``Predictor.get_output(i)``
+==============================  =======================================
+
+Where the reference amalgamates a NaiveEngine build for deployment, the
+TPU path exports the jitted forward as **StableHLO** (`Predictor.export`
+/ `load_exported`) — a self-contained artifact an XLA runtime can execute
+with no Python graph machinery, the analog of the amalgamation's
+single-file predict build.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from . import context as ctx_mod
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+__all__ = ["Predictor", "load_exported"]
+
+
+class Predictor:
+    """Inference-only executor from a trained model.
+
+    Parameters
+    ----------
+    symbol : Symbol or str
+        The network — a Symbol, a JSON string, or a path to a
+        ``*-symbol.json`` file.
+    params : dict, str, or bytes
+        ``{name: NDArray/ndarray}`` (``arg:``/``aux:`` prefixes optional),
+        a ``.params`` file path, or the file's bytes.
+    input_shapes : dict
+        ``{input_name: shape}`` for every data input.
+    ctx : Context, optional
+        Device; defaults to cpu.
+    output_names : list of str, optional
+        Predict a subset / internal nodes instead of the symbol's outputs
+        (``MXPredCreatePartialOut``).  Names may be given with or without
+        the ``_output`` suffix.
+    type_dict : dict, optional
+        Input dtypes (defaults come from graph dtype inference).
+    """
+
+    def __init__(self, symbol, params, input_shapes, ctx=None,
+                 output_names=None, type_dict=None):
+        import jax
+
+        if isinstance(symbol, str):
+            if symbol.lstrip().startswith("{"):
+                symbol = sym_mod.load_json(symbol)
+            else:
+                symbol = sym_mod.load(symbol)
+        if output_names:
+            internals = symbol.get_internals()
+            available = internals.list_outputs()
+            picked = []
+            for name in output_names:
+                cands = [name, name + "_output"]
+                hit = next((c for c in cands if c in available), None)
+                if hit is None:
+                    raise MXNetError(
+                        "output %r not found among internal nodes" % name)
+                picked.append(internals[hit])
+            symbol = sym_mod.Group(picked)
+
+        arg_params, aux_params = _as_param_dicts(params)
+        self._symbol = symbol
+        self._ctx = ctx if ctx is not None else ctx_mod.cpu()
+        self._input_shapes = dict(input_shapes)
+        self._type_dict = dict(type_dict) if type_dict else None
+
+        arg_names = symbol.list_arguments()
+        # free inputs = args without stored weights; ones the caller gave no
+        # shape for (e.g. loss labels) are inferred and fed zeros — the
+        # reference predictor likewise keeps label inputs unbound
+        self._data_names = [n for n in arg_names
+                            if n not in arg_params and n not in aux_params]
+        extra = [n for n in self._input_shapes if n not in self._data_names]
+        if extra:
+            raise MXNetError("input_shapes names %s are not free inputs of "
+                             "the symbol" % extra)
+
+        self._exec = symbol.simple_bind(
+            self._ctx, grad_req="null", type_dict=self._type_dict,
+            **self._input_shapes)
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        self._outputs = None
+        self._jit_fn = None
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, **kwargs):
+        """Build from ``prefix-symbol.json`` + ``prefix-####.params``."""
+        from .model import load_checkpoint
+
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        params = {("arg:%s" % k): v for k, v in arg_params.items()}
+        params.update({("aux:%s" % k): v for k, v in aux_params.items()})
+        return cls(symbol, params, input_shapes, **kwargs)
+
+    # ------------------------------------------------------------------
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def output_shapes(self):
+        _, out_shapes, _ = self._symbol.infer_shape(**self._input_shapes)
+        return list(zip(self.output_names, out_shapes))
+
+    def forward(self, **inputs):
+        """Run inference; returns the list of output NDArrays."""
+        feeds = {}
+        for name, value in inputs.items():
+            if name not in self._data_names:
+                raise MXNetError("unknown input %r (inputs are %s)"
+                                 % (name, self._data_names))
+            if not isinstance(value, nd.NDArray):
+                value = nd.array(np.asarray(value), ctx=self._ctx)
+            bound = self._input_shapes.get(
+                name, self._exec.arg_dict[name].shape)
+            if tuple(value.shape) != tuple(bound):
+                raise MXNetError(
+                    "input %r shape %s does not match bound shape %s — use "
+                    "reshape()" % (name, value.shape, bound))
+            feeds[name] = value
+        self._exec.forward(is_train=False, **feeds)
+        self._outputs = self._exec.outputs
+        return list(self._outputs)
+
+    def get_output(self, index=0):
+        if self._outputs is None:
+            raise MXNetError("call forward() before get_output()")
+        return self._outputs[index]
+
+    def reshape(self, input_shapes):
+        """New Predictor bound to different input shapes, sharing weights
+        (``MXPredReshape``)."""
+        shapes = dict(self._input_shapes)
+        shapes.update(input_shapes)
+        clone = Predictor.__new__(Predictor)
+        clone._symbol = self._symbol
+        clone._ctx = self._ctx
+        clone._input_shapes = shapes
+        clone._type_dict = self._type_dict
+        clone._data_names = self._data_names
+        clone._arg_params = self._arg_params
+        clone._aux_params = self._aux_params
+        clone._exec = self._symbol.simple_bind(
+            self._ctx, grad_req="null", type_dict=self._type_dict, **shapes)
+        clone._exec.copy_params_from(self._arg_params, self._aux_params,
+                                     allow_extra_params=True)
+        clone._outputs = None
+        clone._jit_fn = None
+        return clone
+
+    # ------------------------------------------------------------------
+    def _pure_fn(self):
+        """The forward pass as a pure jax function of the *provided* data
+        inputs; weights — and unfed inputs like labels — are captured so
+        export folds them into the artifact."""
+        import jax
+
+        exe = self._exec
+        feed_names = [n for n in self._data_names if n in self._input_shapes]
+        params = {n: exe.arg_dict[n].data for n in exe._arg_names
+                  if n not in feed_names}
+        aux = {n: exe.aux_dict[n].data for n in exe._aux_names}
+
+        def fn(*data_vals):
+            env_args = dict(params)
+            env_args.update(zip(feed_names, data_vals))
+            outs, _ = exe._run_graph(env_args, dict(aux),
+                                     jax.random.PRNGKey(0), False)
+            return tuple(outs)
+
+        return fn, feed_names
+
+    def export(self, path=None):
+        """Serialize the jitted forward as a StableHLO artifact
+        (``jax.export`` bytes).  The analog of the reference's
+        amalgamated predict-only build: the artifact embeds the weights
+        and needs only an XLA runtime to execute."""
+        import jax
+        from jax import export as jax_export
+
+        fn, feed_names = self._pure_fn()
+        specs = []
+        for n in feed_names:
+            dt = self._exec.arg_dict[n].data.dtype
+            specs.append(
+                jax.ShapeDtypeStruct(tuple(self._input_shapes[n]), dt))
+        exported = jax_export.export(jax.jit(fn))(*specs)
+        blob = exported.serialize()
+        if path is not None:
+            with open(path, "wb") as f:
+                f.write(blob)
+        return blob
+
+    def export_stablehlo_text(self):
+        """Human-readable StableHLO of the forward program."""
+        import jax
+        from jax import export as jax_export
+
+        fn, feed_names = self._pure_fn()
+        specs = []
+        for n in feed_names:
+            dt = self._exec.arg_dict[n].data.dtype
+            specs.append(
+                jax.ShapeDtypeStruct(tuple(self._input_shapes[n]), dt))
+        exported = jax_export.export(jax.jit(fn))(*specs)
+        return exported.mlir_module()
+
+
+def load_exported(blob_or_path):
+    """Deserialize a `Predictor.export` artifact into a callable taking the
+    data inputs (numpy/jax arrays) and returning output arrays."""
+    from jax import export as jax_export
+
+    if isinstance(blob_or_path, str):
+        with open(blob_or_path, "rb") as f:
+            blob = f.read()
+    else:
+        blob = bytes(blob_or_path)
+    exported = jax_export.deserialize(blob)
+
+    def run(*data_vals):
+        return exported.call(*data_vals)
+
+    return run
+
+
+def _as_param_dicts(params):
+    """Normalize any accepted params form into (arg_params, aux_params)."""
+    if isinstance(params, (str, bytes, bytearray, memoryview)):
+        params = nd.load(params)
+    if not isinstance(params, dict):
+        raise MXNetError("params must be a dict, a .params path, or bytes")
+    arg_params, aux_params = {}, {}
+    for key, value in params.items():
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(np.asarray(value))
+        if key.startswith("arg:"):
+            arg_params[key[4:]] = value
+        elif key.startswith("aux:"):
+            aux_params[key[4:]] = value
+        else:
+            arg_params[key] = value
+    return arg_params, aux_params

@@ -1,0 +1,146 @@
+"""Predictor / deployment-export tests.
+
+Capability parity with the reference predict API
+(c_predict_api.h:59-169: MXPredCreate / CreatePartialOut / Reshape /
+Forward / GetOutput) plus the TPU-era StableHLO export path.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.predictor import Predictor, load_exported
+
+
+def _train_small_mlp(tmp_path, prefix="p"):
+    rng = np.random.RandomState(7)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    w = rng.normal(size=(8,)).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+            num_epoch=5)
+    ckpt = str(tmp_path / prefix)
+    mod.save_checkpoint(ckpt, 5)
+    return net, ckpt, x, y
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    net, ckpt, x, y = _train_small_mlp(tmp_path)
+
+    pred = Predictor.from_checkpoint(ckpt, 5, {"data": (16, 8)},
+                                     ctx=mx.cpu())
+    outs = pred.forward(data=x[:16])
+    probs = outs[0].asnumpy()
+    assert probs.shape == (16, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+    # predictions match the Module's own forward
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 8))], for_training=False)
+    s, a, aux = mx.model.load_checkpoint(ckpt, 5)
+    mod.set_params(a, aux)
+    mod.forward(DataBatch([nd.array(x[:16])], []), is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(probs, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_inmemory_blob(tmp_path):
+    """MXPredCreate form: symbol JSON string + raw params bytes."""
+    _, ckpt, x, _ = _train_small_mlp(tmp_path)
+    with open(ckpt + "-symbol.json") as f:
+        json_str = f.read()
+    with open(ckpt + "-0005.params", "rb") as f:
+        blob = f.read()
+
+    pred = Predictor(json_str, blob, {"data": (4, 8)})
+    out = pred.forward(data=x[:4])[0].asnumpy()
+    assert out.shape == (4, 2)
+    # get_output mirrors the returned list
+    np.testing.assert_array_equal(out, pred.get_output(0).asnumpy())
+
+
+def test_predictor_partial_out(tmp_path):
+    """MXPredCreatePartialOut: tap an internal layer as the output."""
+    _, ckpt, x, _ = _train_small_mlp(tmp_path)
+    pred = Predictor.from_checkpoint(ckpt, 5, {"data": (4, 8)},
+                                     output_names=["fc1"])
+    out = pred.forward(data=x[:4])[0].asnumpy()
+    assert out.shape == (4, 16)          # hidden layer activations
+
+
+def test_predictor_reshape(tmp_path):
+    _, ckpt, x, _ = _train_small_mlp(tmp_path)
+    pred = Predictor.from_checkpoint(ckpt, 5, {"data": (16, 8)})
+    big = pred.reshape({"data": (32, 8)})
+    o_small = pred.forward(data=x[:16])[0].asnumpy()
+    o_big = big.forward(data=x[:32])[0].asnumpy()
+    np.testing.assert_allclose(o_big[:16], o_small, rtol=1e-5, atol=1e-6)
+    # shape mismatch is an error, not silent misbehavior
+    with pytest.raises(mx.MXNetError):
+        pred.forward(data=x[:32])
+
+
+def test_predictor_shape_introspection(tmp_path):
+    _, ckpt, _, _ = _train_small_mlp(tmp_path)
+    pred = Predictor.from_checkpoint(ckpt, 5, {"data": (16, 8)})
+    shapes = dict(pred.output_shapes)
+    assert shapes["softmax_output"] == (16, 2)
+
+
+def test_stablehlo_export_roundtrip(tmp_path):
+    """export() -> bytes -> load_exported() reproduces the forward with no
+    symbol/executor machinery (deployment path)."""
+    _, ckpt, x, _ = _train_small_mlp(tmp_path)
+    pred = Predictor.from_checkpoint(ckpt, 5, {"data": (8, 8)})
+    ref = pred.forward(data=x[:8])[0].asnumpy()
+
+    path = str(tmp_path / "model.shlo")
+    blob = pred.export(path)
+    assert isinstance(blob, (bytes, bytearray)) and len(blob) > 0
+
+    run = load_exported(path)
+    out = np.asarray(run(x[:8])[0])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    text = pred.export_stablehlo_text()
+    assert "stablehlo" in text or "mhlo" in text or "func" in text
+
+
+def test_predictor_conv_model(tmp_path):
+    """A conv net predicts through the same path (covers BN aux states)."""
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                          name="c1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    rng = np.random.RandomState(3)
+    x = rng.normal(size=(8, 1, 8, 8)).astype(np.float32)
+    y = rng.randint(0, 3, size=(8,)).astype(np.float32)
+    mod.fit(NDArrayIter(x, y, batch_size=8), optimizer="sgd",
+            initializer=mx.initializer.Xavier(), num_epoch=1)
+    ckpt = str(tmp_path / "conv")
+    mod.save_checkpoint(ckpt, 1)
+
+    pred = Predictor.from_checkpoint(ckpt, 1, {"data": (8, 1, 8, 8)})
+    out = pred.forward(data=x)[0].asnumpy()
+    assert out.shape == (8, 3)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
