@@ -49,7 +49,7 @@ def register_all():
 
     reg("uniform", _uniform,
         base_schema(Param("low", float, default=0.0), Param("high", float, default=1.0)),
-        aliases=["_sample_uniform", "random_uniform"])
+        aliases=["random_uniform"])
 
     def _normal(attrs, key):
         shape, dt = _shape_dtype(attrs, jnp)
@@ -59,7 +59,7 @@ def register_all():
 
     reg("normal", _normal,
         base_schema(Param("loc", float, default=0.0), Param("scale", float, default=1.0)),
-        aliases=["_sample_normal", "random_normal"])
+        aliases=["random_normal"])
 
     def _gamma(attrs, key):
         shape, dt = _shape_dtype(attrs, jnp)
@@ -67,27 +67,24 @@ def register_all():
         b = attrs.get("beta", 1.0)
         return (jax.random.gamma(key, a, shape) * b).astype(dt)
 
-    reg("_sample_gamma", _gamma,
-        base_schema(Param("alpha", float, default=1.0), Param("beta", float, default=1.0)),
-        aliases=["random_gamma"])
+    reg("random_gamma", _gamma,
+        base_schema(Param("alpha", float, default=1.0), Param("beta", float, default=1.0)))
 
     def _exponential(attrs, key):
         shape, dt = _shape_dtype(attrs, jnp)
         lam = attrs.get("lam", 1.0)
         return (jax.random.exponential(key, shape) / lam).astype(dt)
 
-    reg("_sample_exponential", _exponential,
-        base_schema(Param("lam", float, default=1.0)),
-        aliases=["random_exponential"])
+    reg("random_exponential", _exponential,
+        base_schema(Param("lam", float, default=1.0)))
 
     def _poisson(attrs, key):
         shape, dt = _shape_dtype(attrs, jnp)
         lam = attrs.get("lam", 1.0)
         return jax.random.poisson(key, lam, shape).astype(dt)
 
-    reg("_sample_poisson", _poisson,
-        base_schema(Param("lam", float, default=1.0)),
-        aliases=["random_poisson"])
+    reg("random_poisson", _poisson,
+        base_schema(Param("lam", float, default=1.0)))
 
     def _neg_binomial(attrs, key):
         shape, dt = _shape_dtype(attrs, jnp)
@@ -97,9 +94,8 @@ def register_all():
         lam = jax.random.gamma(k1, k, shape) * (1 - p) / p
         return jax.random.poisson(k2, lam, shape).astype(dt)
 
-    reg("_sample_negative_binomial", _neg_binomial,
-        base_schema(Param("k", int, default=1), Param("p", float, default=1.0)),
-        aliases=["random_negative_binomial"])
+    reg("random_negative_binomial", _neg_binomial,
+        base_schema(Param("k", int, default=1), Param("p", float, default=1.0)))
 
     def _gen_neg_binomial(attrs, key):
         shape, dt = _shape_dtype(attrs, jnp)
@@ -110,6 +106,64 @@ def register_all():
         lam = jax.random.gamma(k1, r, shape) * (mu * alpha)
         return jax.random.poisson(k2, lam, shape).astype(dt)
 
-    reg("_sample_generalized_negative_binomial", _gen_neg_binomial,
-        base_schema(Param("mu", float, default=1.0), Param("alpha", float, default=1.0)),
-        aliases=["random_generalized_negative_binomial"])
+    reg("random_generalized_negative_binomial", _gen_neg_binomial,
+        base_schema(Param("mu", float, default=1.0), Param("alpha", float, default=1.0)))
+
+    # -----------------------------------------------------------------
+    # Multisample family (ref: src/operator/tensor/multisample_op.cc):
+    # distribution params are TENSORS; each element draws `shape` samples
+    # -> output shape = param.shape + shape.
+    # -----------------------------------------------------------------
+    ms_schema = ParamSchema(Param("shape", "shape", default=()),
+                            Param("dtype", str, default="float32"))
+
+    def reg_ms(name, draw, num_inputs):
+        def _ms_shape(attrs, in_shapes, aux_shapes):
+            s = tuple(attrs.get("shape", ()) or ())
+            base = tuple(in_shapes[0]) if in_shapes[0] is not None else ()
+            return [tuple(base)] * num_inputs, [base + s], []
+
+        def fcompute(attrs, inputs, aux, octx):
+            s = tuple(attrs.get("shape", ()) or ())
+            dt = attrs.get("dtype", "float32") or "float32"
+            dt = jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt)
+            base = tuple(inputs[0].shape)
+            out_shape = base + s
+            ps = [p.reshape(base + (1,) * len(s)).astype(jnp.float32)
+                  for p in inputs]
+            return [draw(octx.rng, out_shape, *ps).astype(dt)], []
+
+        register_op(OpDef(name, fcompute, schema=ms_schema,
+                          num_inputs=num_inputs, needs_rng=True,
+                          infer_shape=_ms_shape, hint=name.lstrip("_")))
+
+    reg_ms("_sample_uniform",
+           lambda key, sh, lo, hi:
+           lo + jax.random.uniform(key, sh) * (hi - lo), 2)
+    reg_ms("_sample_normal",
+           lambda key, sh, mu, sigma:
+           mu + jax.random.normal(key, sh) * sigma, 2)
+    reg_ms("_sample_gamma",
+           lambda key, sh, alpha, beta:
+           jax.random.gamma(key, jnp.broadcast_to(alpha, sh)) * beta, 2)
+    reg_ms("_sample_exponential",
+           lambda key, sh, lam:
+           jax.random.exponential(key, sh) / lam, 1)
+    reg_ms("_sample_poisson",
+           lambda key, sh, lam:
+           jax.random.poisson(key, jnp.broadcast_to(lam, sh)), 1)
+
+    def _ms_neg_binomial(key, sh, k, p):
+        k1, k2 = jax.random.split(key)
+        lam = jax.random.gamma(k1, jnp.broadcast_to(k, sh)) * (1 - p) / p
+        return jax.random.poisson(k2, lam)
+
+    reg_ms("_sample_negative_binomial", _ms_neg_binomial, 2)
+
+    def _ms_gen_neg_binomial(key, sh, mu, alpha):
+        k1, k2 = jax.random.split(key)
+        r = 1.0 / alpha
+        lam = jax.random.gamma(k1, jnp.broadcast_to(r, sh)) * (mu * alpha)
+        return jax.random.poisson(k2, lam)
+
+    reg_ms("_sample_generalized_negative_binomial", _ms_gen_neg_binomial, 2)
