@@ -1,0 +1,99 @@
+"""Native runtime loader.
+
+The reference's runtime core is C++ (`src/` — engine, storage, io, C ABI).
+On TPU, XLA subsumes the engine/storage layers, but byte-pushing IO is
+still native here: `src/recordio.cc` implements the RecordIO codec behind
+a small C ABI, loaded over ctypes (this environment has no pybind11; the
+CPython-free C ABI also keeps the door open for non-Python frontends,
+reference `include/mxnet/c_api.h`).
+
+The shared library is built on demand from the repo's `src/` with g++ and
+cached in `mxnet_tpu/lib/`; everything degrades to the pure-Python
+implementations when a toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "src")
+_LIBDIR = os.path.join(_HERE, "lib")
+
+_lock = threading.Lock()
+_recordio = None
+_recordio_tried = False
+
+
+def _build(src_path, lib_path):
+    os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+    # compile to a private temp name, then rename: the build must be atomic
+    # against concurrent processes (dist tests spawn several), and an
+    # in-place rewrite would truncate an inode another process has mapped
+    tmp_path = "%s.tmp.%d" % (lib_path, os.getpid())
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           src_path, "-o", tmp_path]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.rename(tmp_path, lib_path)
+    finally:
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+
+
+def _configure_recordio(lib):
+    lib.rio_last_error.restype = ctypes.c_char_p
+    lib.rio_writer_open.restype = ctypes.c_void_p
+    lib.rio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.rio_writer_tell.restype = ctypes.c_int64
+    lib.rio_writer_tell.argtypes = [ctypes.c_void_p]
+    lib.rio_writer_write.restype = ctypes.c_int64
+    lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+    lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_open.restype = ctypes.c_void_p
+    lib.rio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.rio_reader_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rio_reader_tell.restype = ctypes.c_int64
+    lib.rio_reader_tell.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_next.restype = ctypes.c_int
+    lib.rio_reader_next.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_void_p),
+                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.rio_reader_close.argtypes = [ctypes.c_void_p]
+    lib.rio_build_index.restype = ctypes.c_int64
+    lib.rio_build_index.argtypes = [ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+    lib.rio_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def recordio_lib():
+    """The native RecordIO library, building it on first use.  Returns the
+    configured CDLL, or None when native IO is unavailable."""
+    global _recordio, _recordio_tried
+    with _lock:
+        if _recordio_tried:
+            return _recordio
+        _recordio_tried = True
+        src = os.path.join(_SRC, "recordio.cc")
+        lib_path = os.path.join(_LIBDIR, "libmxtpu_io.so")
+        try:
+            if not os.path.isfile(src):
+                return None
+            if (not os.path.isfile(lib_path)
+                    or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+                _build(src, lib_path)
+            _recordio = _configure_recordio(ctypes.CDLL(lib_path))
+        except (OSError, subprocess.CalledProcessError) as exc:
+            logging.info("native RecordIO unavailable (%s); using the "
+                         "pure-Python codec", exc)
+            _recordio = None
+        return _recordio
+
+
+def native_error(lib):
+    return lib.rio_last_error().decode()

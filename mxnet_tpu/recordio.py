@@ -18,35 +18,56 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "build_index"]
 
 _kMagic = 0xCED7230A
 
 
 class MXRecordIO:
-    """Sequential RecordIO reader/writer (reference: recordio.py:12)."""
+    """Sequential RecordIO reader/writer (reference: recordio.py:12).
+
+    Backed by the native C++ codec (`src/recordio.cc`, dmlc-core recordio
+    analog — handles split-record reassembly) when the toolchain built it;
+    degrades to a pure-Python codec otherwise."""
 
     def __init__(self, uri, flag):
+        from . import _native
+
         self.uri = uri
         self.flag = flag
         self.handle = None
         self.is_open = False
+        self._lib = _native.recordio_lib()
         self.open()
 
     def open(self):
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        if self._lib is not None:
+            opener = (self._lib.rio_writer_open if self.writable
+                      else self._lib.rio_reader_open)
+            self.handle = opener(self.uri.encode())
+            if not self.handle:
+                from ._native import native_error
+
+                raise MXNetError(native_error(self._lib))
+        else:
+            self.handle = open(self.uri, "wb" if self.writable else "rb")
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._lib is not None:
+                closer = (self._lib.rio_writer_close if self.writable
+                          else self._lib.rio_reader_close)
+                closer(self.handle)
+                self.handle = None
+            else:
+                self.handle.close()
             self.is_open = False
 
     def __del__(self):
@@ -57,13 +78,25 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._lib is not None:
+            teller = (self._lib.rio_writer_tell if self.writable
+                      else self._lib.rio_reader_tell)
+            return teller(self.handle)
         return self.handle.tell()
 
     def write(self, buf):
         assert self.writable
         data = bytes(buf)
+        if self._lib is not None:
+            from ._native import native_error
+
+            if self._lib.rio_writer_write(self.handle, data, len(data)) < 0:
+                raise MXNetError(native_error(self._lib))
+            return
         # single-record encoding (cflag=0); large records are not split
-        self.handle.write(struct.pack("<II", _kMagic, len(data) & 0x1FFFFFFF))
+        if len(data) > 0x1FFFFFFF:
+            raise MXNetError("record too large (max 2^29-1 bytes per frame)")
+        self.handle.write(struct.pack("<II", _kMagic, len(data)))
         self.handle.write(data)
         pad = (4 - len(data) % 4) % 4
         if pad:
@@ -71,18 +104,51 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", header)
-        if magic != _kMagic:
-            raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
-        length = lrec & 0x1FFFFFFF
-        data = self.handle.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.handle.read(pad)
-        return data
+        if self._lib is not None:
+            from ._native import native_error
+
+            data_p = ctypes.c_void_p()
+            length = ctypes.c_uint64()
+            rc = self._lib.rio_reader_next(self.handle,
+                                           ctypes.byref(data_p),
+                                           ctypes.byref(length))
+            if rc == 0:
+                return None
+            if rc < 0:
+                raise MXNetError(native_error(self._lib))
+            return ctypes.string_at(data_p, length.value)
+        record = None
+        while True:
+            header = self.handle.read(8)
+            if len(header) < 8:
+                if record is not None:
+                    raise MXNetError("unterminated split record in %s"
+                                     % self.uri)
+                return None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _kMagic:
+                raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
+            cflag, length = lrec >> 29, lrec & 0x1FFFFFFF
+            data = self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            # dmlc writers split records whose payload embeds the magic:
+            # cflag 0 whole, 1 first, 2 middle, 3 last — reassemble
+            if record is None:
+                if cflag == 0:
+                    return data
+                if cflag != 1:
+                    raise MXNetError("unexpected continuation frame in %s"
+                                     % self.uri)
+                record = bytearray(data)
+            else:
+                if cflag not in (2, 3):
+                    raise MXNetError("corrupt split-record chain in %s"
+                                     % self.uri)
+                record.extend(data)
+                if cflag == 3:
+                    return bytes(record)
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -116,7 +182,13 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.handle.seek(self.idx[idx])
+        if self._lib is not None:
+            from ._native import native_error
+
+            if self._lib.rio_reader_seek(self.handle, self.idx[idx]) < 0:
+                raise MXNetError(native_error(self._lib))
+        else:
+            self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
@@ -127,6 +199,35 @@ class MXIndexedRecordIO(MXRecordIO):
         self.idx[key] = self.tell()
         self.keys.append(key)
         self.write(buf)
+
+
+def build_index(rec_path, idx_path=None):
+    """Scan a .rec file and produce its record-start offsets (the .idx
+    sidecar `tools/im2rec` emits).  Uses the native scanner when built."""
+    from . import _native
+
+    lib = _native.recordio_lib()
+    if lib is not None:
+        out = ctypes.POINTER(ctypes.c_int64)()
+        count = lib.rio_build_index(rec_path.encode(), ctypes.byref(out))
+        if count < 0:
+            raise MXNetError(_native.native_error(lib))
+        offsets = [out[i] for i in range(count)]
+        lib.rio_free(out)
+    else:
+        offsets = []
+        reader = MXRecordIO(rec_path, "r")
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            offsets.append(pos)
+        reader.close()
+    if idx_path is not None:
+        with open(idx_path, "w") as fout:
+            for i, pos in enumerate(offsets):
+                fout.write("%d\t%d\n" % (i, pos))
+    return offsets
 
 
 class IRHeader:

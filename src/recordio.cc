@@ -1,0 +1,235 @@
+// Native RecordIO codec — the TPU framework's analog of dmlc-core's
+// recordio + the reference's src/io record readers (iter_image_recordio.cc
+// reads this format through dmlc::RecordIOReader).
+//
+// On-disk format (byte-compatible with the reference so .rec files
+// interoperate both ways):
+//   record  := [kMagic:u32le][(cflag<<29)|len:u32le][data:len][pad to 4B]
+//   cflag   := 0 whole record | 1 first part | 2 middle part | 3 last part
+// Split records (cflag 1/2/3) arise when data contains the magic; the
+// reference's writer splits at embedded-magic positions. This reader
+// reassembles them; this writer emits whole records (and escapes nothing:
+// parity with python/recordio.py's single-record writer).
+//
+// Exposed as a C ABI consumed by mxnet_tpu/_native.py over ctypes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xCED7230A;
+constexpr uint32_t kLenMask = 0x1FFFFFFF;
+
+thread_local std::string g_error;
+
+void set_error(const std::string &msg) { g_error = msg; }
+
+struct Writer {
+  FILE *fp = nullptr;
+  explicit Writer(const char *path) { fp = std::fopen(path, "wb"); }
+  ~Writer() {
+    if (fp) std::fclose(fp);
+  }
+};
+
+struct Reader {
+  FILE *fp = nullptr;
+  std::vector<uint8_t> buf;   // last record's reassembled payload
+  explicit Reader(const char *path) { fp = std::fopen(path, "rb"); }
+  ~Reader() {
+    if (fp) std::fclose(fp);
+  }
+};
+
+// Reads one framed chunk. Returns 1 on success, 0 on clean EOF, -1 on error.
+int read_chunk(FILE *fp, std::vector<uint8_t> *out, uint32_t *cflag) {
+  uint32_t header[2];
+  size_t n = std::fread(header, 1, sizeof(header), fp);
+  if (n == 0) return 0;
+  if (n != sizeof(header)) {
+    set_error("truncated record header");
+    return -1;
+  }
+  if (header[0] != kMagic) {
+    set_error("bad RecordIO magic");
+    return -1;
+  }
+  *cflag = header[1] >> 29;
+  uint32_t len = header[1] & kLenMask;
+  size_t old = out->size();
+  out->resize(old + len);
+  if (len && std::fread(out->data() + old, 1, len, fp) != len) {
+    set_error("truncated record payload");
+    return -1;
+  }
+  uint32_t pad = (4u - (len & 3u)) & 3u;
+  if (pad) {
+    uint8_t scratch[4];
+    if (std::fread(scratch, 1, pad, fp) != pad) {
+      set_error("truncated record padding");
+      return -1;
+    }
+  }
+  return 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *rio_last_error() { return g_error.c_str(); }
+
+// ---------------------------------------------------------------- writer --
+void *rio_writer_open(const char *path) {
+  Writer *w = new Writer(path);
+  if (!w->fp) {
+    set_error(std::string("cannot open for write: ") + path);
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int64_t rio_writer_tell(void *h) {
+  return static_cast<int64_t>(std::ftell(static_cast<Writer *>(h)->fp));
+}
+
+// Returns the record's start offset (for indexing), or -1 on error.
+int64_t rio_writer_write(void *h, const void *data, uint64_t len) {
+  Writer *w = static_cast<Writer *>(h);
+  if (len > kLenMask) {
+    set_error("record too large (max 2^29-1 bytes per frame)");
+    return -1;
+  }
+  int64_t start = std::ftell(w->fp);
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(len) & kLenMask};
+  if (std::fwrite(header, 1, sizeof(header), w->fp) != sizeof(header) ||
+      (len && std::fwrite(data, 1, len, w->fp) != len)) {
+    set_error("short write");
+    return -1;
+  }
+  uint32_t pad = (4u - (len & 3u)) & 3u;
+  if (pad) {
+    const uint8_t zeros[4] = {0, 0, 0, 0};
+    if (std::fwrite(zeros, 1, pad, w->fp) != pad) {
+      set_error("short write (pad)");
+      return -1;
+    }
+  }
+  return start;
+}
+
+int rio_writer_close(void *h) {
+  delete static_cast<Writer *>(h);
+  return 0;
+}
+
+// ---------------------------------------------------------------- reader --
+void *rio_reader_open(const char *path) {
+  Reader *r = new Reader(path);
+  if (!r->fp) {
+    set_error(std::string("cannot open for read: ") + path);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+int rio_reader_seek(void *h, int64_t offset) {
+  Reader *r = static_cast<Reader *>(h);
+  if (std::fseek(r->fp, static_cast<long>(offset), SEEK_SET) != 0) {
+    set_error("seek failed");
+    return -1;
+  }
+  return 0;
+}
+
+int64_t rio_reader_tell(void *h) {
+  return static_cast<int64_t>(std::ftell(static_cast<Reader *>(h)->fp));
+}
+
+// Next whole (reassembled) record. 1 ok (data/len valid until next call),
+// 0 EOF, -1 error.
+int rio_reader_next(void *h, const void **data, uint64_t *len) {
+  Reader *r = static_cast<Reader *>(h);
+  r->buf.clear();
+  uint32_t cflag = 0;
+  int rc = read_chunk(r->fp, &r->buf, &cflag);
+  if (rc <= 0) return rc;
+  if (cflag == 1) {  // split record: keep consuming until the closing part
+    for (;;) {
+      rc = read_chunk(r->fp, &r->buf, &cflag);
+      if (rc <= 0) {
+        set_error("unterminated split record");
+        return -1;
+      }
+      if (cflag == 3) break;
+      if (cflag != 2) {
+        set_error("corrupt split-record chain");
+        return -1;
+      }
+    }
+  } else if (cflag != 0) {
+    set_error("unexpected continuation frame");
+    return -1;
+  }
+  *data = r->buf.data();
+  *len = r->buf.size();
+  return 1;
+}
+
+int rio_reader_close(void *h) {
+  delete static_cast<Reader *>(h);
+  return 0;
+}
+
+// ------------------------------------------------------------------ index --
+// Scans the file and returns every record's start offset (caller frees via
+// rio_free). Returns record count, or -1 on error.
+int64_t rio_build_index(const char *path, int64_t **offsets_out) {
+  Reader r(path);
+  if (!r.fp) {
+    set_error(std::string("cannot open: ") + path);
+    return -1;
+  }
+  std::vector<int64_t> offsets;
+  std::vector<uint8_t> scratch;
+  for (;;) {
+    int64_t pos = std::ftell(r.fp);
+    scratch.clear();
+    uint32_t cflag = 0;
+    int rc = read_chunk(r.fp, &scratch, &cflag);
+    if (rc == 0) break;
+    if (rc < 0) return -1;
+    if (cflag == 0) {
+      offsets.push_back(pos);
+    } else if (cflag == 1) {
+      offsets.push_back(pos);
+      for (;;) {
+        scratch.clear();
+        rc = read_chunk(r.fp, &scratch, &cflag);
+        if (rc <= 0) {
+          set_error("unterminated split record");
+          return -1;
+        }
+        if (cflag == 3) break;
+      }
+    } else {
+      set_error("index scan hit continuation frame out of sequence");
+      return -1;
+    }
+  }
+  auto *arr = static_cast<int64_t *>(
+      std::malloc(sizeof(int64_t) * (offsets.empty() ? 1 : offsets.size())));
+  std::memcpy(arr, offsets.data(), sizeof(int64_t) * offsets.size());
+  *offsets_out = arr;
+  return static_cast<int64_t>(offsets.size());
+}
+
+void rio_free(void *p) { std::free(p); }
+
+}  // extern "C"

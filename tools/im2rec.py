@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""im2rec — pack an image directory / list into RecordIO (.rec + .idx).
+
+TPU-framework analog of the reference's ``tools/im2rec.py``:
+
+  1. list mode:   python tools/im2rec.py --list prefix image_root
+     Walks image_root, assigns integer labels per subdirectory, writes
+     ``prefix.lst`` lines of ``index\\tlabel\\trelative_path``.
+  2. pack mode:   python tools/im2rec.py prefix image_root
+     Reads ``prefix.lst`` and packs ``prefix.rec`` + ``prefix.idx`` through
+     the native RecordIO writer (src/recordio.cc).  Images decode with cv2
+     when available; without cv2 only ``.npy`` array files are packable
+     (via the raw-array codec recordio.pack_img/unpack_img share) — other
+     formats are skipped with a warning rather than written undecodably.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".npy"}
+
+
+def make_list(prefix, root, shuffle=True, train_ratio=1.0, seed=0):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_map = {c: i for i, c in enumerate(classes)}
+    items = []
+    if classes:
+        for cls in classes:
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for fname in sorted(files):
+                    if os.path.splitext(fname)[1].lower() in EXTS:
+                        rel = os.path.relpath(os.path.join(dirpath, fname),
+                                              root)
+                        items.append((label_map[cls], rel))
+    else:  # flat directory: label 0
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in EXTS:
+                items.append((0, fname))
+    if shuffle:
+        random.Random(seed).shuffle(items)
+    n_train = int(len(items) * train_ratio)
+    splits = [("", items[:n_train])]
+    if train_ratio < 1.0:
+        splits = [("_train", items[:n_train]), ("_val", items[n_train:])]
+    for suffix, split in splits:
+        with open(prefix + suffix + ".lst", "w") as fout:
+            for i, (label, rel) in enumerate(split):
+                fout.write("%d\t%f\t%s\n" % (i, label, rel))
+    print("wrote %d entries for %s" % (len(items), prefix))
+
+
+def read_list(lst_path):
+    with open(lst_path) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def pack_records(prefix, root, quality=95, resize=0, color=1):
+    import numpy as np
+
+    from mxnet_tpu import recordio
+
+    try:
+        import cv2
+    except ImportError:
+        cv2 = None
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        if path.endswith(".npy"):
+            img = np.load(path)
+        elif cv2 is not None:
+            img = cv2.imread(path, color)
+            if img is None:
+                print("skipping unreadable %s" % path, file=sys.stderr)
+                continue
+            if resize:
+                h, w = img.shape[:2]
+                scale = resize / min(h, w)
+                img = cv2.resize(img, (int(w * scale), int(h * scale)))
+        else:
+            print("skipping %s: no cv2 to decode it (use .npy inputs for "
+                  "the cv2-free path)" % path, file=sys.stderr)
+            continue
+        payload = recordio.pack_img(header, img, quality=quality)
+        rec.write_idx(idx, payload)
+        count += 1
+    rec.close()
+    print("packed %d records into %s.rec" % (count, prefix))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate prefix.lst instead of packing")
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    args = ap.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root, shuffle=not args.no_shuffle,
+                  train_ratio=args.train_ratio)
+    else:
+        pack_records(args.prefix, args.root, quality=args.quality,
+                     resize=args.resize, color=args.color)
+
+
+if __name__ == "__main__":
+    main()
