@@ -31,7 +31,9 @@ __all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
            "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
            "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
            "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
-           "ImageRecordIter"]
+           "ImageRecordIter", "DetAugmenter", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetBorderAug", "CreateDetAugmenter",
+           "ImageDetIter", "ImageDetRecordIter"]
 
 _LUMA = np.array([0.299, 0.587, 0.114], np.float32)  # ITU-R BT.601
 
@@ -440,8 +442,9 @@ class ImageIter(DataIter):
             self._rng.shuffle(self._order)
 
     # -- sample stream -----------------------------------------------------
-    def next_sample(self):
-        """(label, decoded HWC image) for the next sample."""
+    def _next_raw(self):
+        """(label, undecoded payload) for the next sample — the one copy of
+        the order/cursor/label-override protocol (det iterator reuses it)."""
         if self._order is not None:
             if self._cursor >= len(self._order):
                 raise StopIteration
@@ -450,8 +453,12 @@ class ImageIter(DataIter):
             label, payload = self._source.read(key)
             if self._labels is not None:
                 label = self._labels[key][0]
-        else:
-            label, payload = self._source.read()
+            return label, payload
+        return self._source.read()
+
+    def next_sample(self):
+        """(label, decoded HWC image) for the next sample."""
+        label, payload = self._next_raw()
         return label, self._decode(payload, label)
 
     def _decode(self, payload, label):
@@ -511,4 +518,281 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
                       rand_mirror=rand_mirror, mean=mean, std=std,
                       num_parts=num_parts, part_index=part_index, seed=seed,
                       **{k: v for k, v in kwargs.items() if k in passthrough})
+    return io_mod.PrefetchingIter(inner, capacity=prefetch_buffer)
+
+
+# ---------------------------------------------------------------------------
+# Detection pipeline (reference: src/io/iter_image_det_recordio.cc +
+# image_det_aug_default.cc).  Labels are object lists
+# ``[header_width, object_width, ...header extras, (cls, xmin, ymin, xmax,
+# ymax)*]`` with normalized [0,1] corner coordinates; augmenters transform
+# boxes together with pixels.
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Augmenter over (image, boxes): boxes is (N, >=5) [cls, x0, y0, x1, y1]
+    in normalized coordinates."""
+
+    def __init__(self, fn, rng=None):
+        self._fn = fn
+        self.rng = _rng_of(rng)
+
+    def __call__(self, img, boxes):
+        return self._fn(img, boxes, self.rng)
+
+
+def DetHorizontalFlipAug(p, seed=None):
+    """Mirror image and x-coordinates together (det_aug_default mirror)."""
+    def flip(img, boxes, rng):
+        if rng.random() < p:
+            img = img[:, ::-1]
+            boxes = boxes.copy()
+            x0 = boxes[:, 1].copy()
+            boxes[:, 1] = 1.0 - boxes[:, 3]
+            boxes[:, 3] = 1.0 - x0
+        return img, boxes
+
+    return DetAugmenter(flip, np.random.default_rng(seed))
+
+
+def DetRandomCropAug(min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                     area_range=(0.3, 1.0), max_attempts=20, seed=None):
+    """Sample a crop keeping enough of the objects (SSD-style data aug,
+    image_det_aug_default.cc crop sampling); boxes are clipped and
+    re-normalized to the crop, fully-cropped-out objects dropped."""
+    def crop(img, boxes, rng):
+        h, w = img.shape[:2]
+        for _ in range(max_attempts):
+            area = rng.uniform(*area_range) * h * w
+            ratio = rng.uniform(*aspect_ratio_range)
+            cw = int(round(np.sqrt(area * ratio)))
+            ch = int(round(np.sqrt(area / ratio)))
+            if cw > w or ch > h or cw <= 0 or ch <= 0:
+                continue
+            x0 = rng.integers(0, w - cw + 1)
+            y0 = rng.integers(0, h - ch + 1)
+            cx0, cy0 = x0 / w, y0 / h
+            cx1, cy1 = (x0 + cw) / w, (y0 + ch) / h
+            if len(boxes):
+                ix0 = np.maximum(boxes[:, 1], cx0)
+                iy0 = np.maximum(boxes[:, 2], cy0)
+                ix1 = np.minimum(boxes[:, 3], cx1)
+                iy1 = np.minimum(boxes[:, 4], cy1)
+                inter = np.clip(ix1 - ix0, 0, None) * \
+                    np.clip(iy1 - iy0, 0, None)
+                obj = (boxes[:, 3] - boxes[:, 1]) * (boxes[:, 4] - boxes[:, 2])
+                covered = np.where(obj > 0, inter / np.maximum(obj, 1e-12), 0)
+                keep = covered >= min_object_covered
+                if not keep.any():
+                    continue
+            else:
+                keep = np.zeros((0,), bool)
+            img = img[y0:y0 + ch, x0:x0 + cw]
+            boxes = boxes[keep].copy()
+            if len(boxes):
+                sw, sh = cx1 - cx0, cy1 - cy0
+                boxes[:, 1] = np.clip((boxes[:, 1] - cx0) / sw, 0, 1)
+                boxes[:, 2] = np.clip((boxes[:, 2] - cy0) / sh, 0, 1)
+                boxes[:, 3] = np.clip((boxes[:, 3] - cx0) / sw, 0, 1)
+                boxes[:, 4] = np.clip((boxes[:, 4] - cy0) / sh, 0, 1)
+            return img, boxes
+        return img, boxes
+
+    return DetAugmenter(crop, np.random.default_rng(seed))
+
+
+def DetBorderAug(pad_ratio_range=(1.0, 1.5), fill=127, seed=None):
+    """Zoom-out padding (expand canvas, objects shrink) — the complement of
+    random crop in SSD augmentation."""
+    def border(img, boxes, rng):
+        ratio = rng.uniform(*pad_ratio_range)
+        if ratio <= 1.0:
+            return img, boxes
+        h, w = img.shape[:2]
+        nh, nw = int(h * ratio), int(w * ratio)
+        y0 = rng.integers(0, nh - h + 1)
+        x0 = rng.integers(0, nw - w + 1)
+        canvas = np.full((nh, nw) + img.shape[2:], fill, img.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = img
+        boxes = boxes.copy()
+        if len(boxes):
+            boxes[:, 1] = (boxes[:, 1] * w + x0) / nw
+            boxes[:, 2] = (boxes[:, 2] * h + y0) / nh
+            boxes[:, 3] = (boxes[:, 3] * w + x0) / nw
+            boxes[:, 4] = (boxes[:, 4] * h + y0) / nh
+        return canvas, boxes
+
+    return DetAugmenter(border, np.random.default_rng(seed))
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       min_object_covered=0.3, area_range=(0.3, 1.0),
+                       aspect_ratio_range=(0.75, 1.33),
+                       pad_ratio_range=(1.0, 1.5), pad_val=127,
+                       inter_method=2, seed=None):
+    """Standard detection chain (det_aug_default): [resize-short] -> [pad]
+    -> [crop] -> resize-to-shape -> [mirror] -> [normalize]."""
+    ss = seed if isinstance(seed, np.random.SeedSequence) \
+        else np.random.SeedSequence(seed)
+    children = iter(ss.spawn(6))
+    augs = []
+    if resize > 0:
+        def resize_aug(img, boxes, rng, _s=resize, _i=inter_method):
+            # box coords are normalized, so a pure resize leaves them alone
+            return resize_short(img, _s, _i), boxes
+
+        augs.append(DetAugmenter(resize_aug))
+    if rand_pad > 0:
+        pad_aug = DetBorderAug(pad_ratio_range, pad_val, next(children))
+        prob = rand_pad
+
+        def maybe_pad(img, boxes, rng, _a=pad_aug, _p=prob):
+            return _a(img, boxes) if rng.random() < _p else (img, boxes)
+
+        augs.append(DetAugmenter(maybe_pad, np.random.default_rng(next(children))))
+    if rand_crop > 0:
+        crop_aug = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                    area_range, seed=next(children))
+        prob = rand_crop
+
+        def maybe_crop(img, boxes, rng, _a=crop_aug, _p=prob):
+            return _a(img, boxes) if rng.random() < _p else (img, boxes)
+
+        augs.append(DetAugmenter(maybe_crop, np.random.default_rng(next(children))))
+
+    h, w = data_shape[1], data_shape[2]
+
+    def force_resize(img, boxes, rng, _i=inter_method):
+        return _resize(img.astype(np.float32), w, h, _i), boxes
+
+    augs.append(DetAugmenter(force_resize))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5, next(children)))
+    if mean is not None or std is not None:
+        m = np.asarray(mean if mean is not None else 0.0, np.float32)
+        s = np.asarray(std if std is not None else 1.0, np.float32)
+
+        def normalize(img, boxes, rng):
+            return (img.astype(np.float32) - m) / s, boxes
+
+        augs.append(DetAugmenter(normalize))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: images + variable-length object-box labels padded
+    to a fixed (batch, max_objects, object_width) tensor (pad value -1),
+    the shape MultiBoxTarget consumes.  Analog of the reference's
+    ImageDetRecordIter (iter_image_det_recordio.cc)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 label_pad_width=None, label_pad_value=-1.0, seed=None,
+                 **kwargs):
+        if aug_list is None:
+            det_keys = ("resize", "rand_crop", "rand_pad", "rand_mirror",
+                        "mean", "std", "min_object_covered", "area_range",
+                        "aspect_ratio_range", "pad_ratio_range", "pad_val",
+                        "inter_method")
+            aug_list = CreateDetAugmenter(
+                data_shape, seed=seed,
+                **{k: v for k, v in kwargs.items() if k in det_keys})
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=aug_list,
+                         imglist=imglist, data_name=data_name,
+                         label_name=label_name, seed=seed)
+        self.label_pad_value = float(label_pad_value)
+        if label_pad_width is None:
+            if num_parts > 1:
+                # each part would scan only its slice and derive a different
+                # max_objs -> mismatched label shapes across workers
+                raise MXNetError(
+                    "ImageDetIter with num_parts>1 needs an explicit "
+                    "label_pad_width so every worker pads identically")
+            label_pad_width, obj_width = self._scan_label_shape()
+        else:
+            # size the object width from the first record even when the pad
+            # width is caller-supplied (labels may be wider than 5)
+            obj_width = self._scan_label_shape(first_only=True)[1]
+        self._obj_width = obj_width or 5
+        self._max_objs = max(1, label_pad_width)
+        self.provide_label = [DataDesc(
+            label_name, (batch_size, self._max_objs, self._obj_width))]
+
+    def _scan_label_shape(self, first_only=False):
+        """Pass over the labels to size the padded tensor (construction-time
+        I/O; pass label_pad_width to skip the full scan)."""
+        max_objs, obj_width = 0, None
+        self.reset()
+        while True:
+            try:
+                label, _ = self._next_raw()
+            except StopIteration:
+                break
+            objs, ow = self._parse_label(label)
+            max_objs = max(max_objs, len(objs))
+            obj_width = ow if obj_width is None else obj_width
+            if first_only:
+                break
+        self.reset()
+        return max_objs, obj_width
+
+    def _parse_label(self, label):
+        """-> (objects (N, obj_width), obj_width).  Accepts the packed
+        header format or a flat (N*5,) / (N,5) array."""
+        raw = np.asarray(label, np.float32).ravel()
+        if raw.size > 2 and float(raw[0]).is_integer() \
+                and 2 <= raw[0] <= raw.size and raw[1] >= 5 \
+                and (raw.size - raw[0]) % raw[1] == 0 \
+                and float(raw[1]).is_integer():
+            hw, ow = int(raw[0]), int(raw[1])
+            return raw[hw:].reshape(-1, ow), ow
+        if raw.size % 5 == 0:
+            return raw.reshape(-1, 5), 5
+        raise MXNetError("cannot parse detection label of size %d" % raw.size)
+
+    def next(self):
+        c, h, w = self.data_shape
+        images = np.zeros((self.batch_size, h, w, c), np.float32)
+        labels = np.full((self.batch_size, self._max_objs, self._obj_width),
+                         self.label_pad_value, np.float32)
+        filled = 0
+        try:
+            while filled < self.batch_size:
+                label, img = self.next_sample()
+                boxes, _ = self._parse_label(label)
+                if img.ndim == 2:
+                    img = np.repeat(img[:, :, None], c, axis=2)
+                for aug in self.auglist:
+                    img, boxes = aug(img, boxes)
+                if img.shape[:2] != (h, w):
+                    img = _resize(img.astype(np.float32), w, h)
+                images[filled] = img
+                n = min(len(boxes), self._max_objs)
+                if n:
+                    width = min(boxes.shape[1], self._obj_width)
+                    labels[filled, :n, :width] = boxes[:n, :width]
+                filled += 1
+        except StopIteration:
+            if filled == 0:
+                raise
+        return DataBatch([nd.array(images.transpose(0, 3, 1, 2))],
+                         [nd.array(labels)],
+                         pad=self.batch_size - filled)
+
+
+def ImageDetRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
+                       shuffle=False, prefetch_buffer=4, seed=None,
+                       **kwargs):
+    """Detection RecordIO pipeline with prefetch (C++ ImageDetRecordIter
+    analog)."""
+    inner = ImageDetIter(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, shuffle=shuffle, seed=seed,
+                         **kwargs)
     return io_mod.PrefetchingIter(inner, capacity=prefetch_buffer)

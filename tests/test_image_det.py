@@ -1,0 +1,135 @@
+"""Detection data pipeline tests (ImageDetIter + box-aware augmenters),
+reference: src/io/iter_image_det_recordio.cc, image_det_aug_default.cc."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+
+def _make_det_rec(tmp_path, n=12, seed=0):
+    """A detection .rec: images with labeled boxes in the packed header
+    format [header_width=2, object_width=5, objs...]."""
+    rng = np.random.RandomState(seed)
+    idx_path = str(tmp_path / "det.idx")
+    rec_path = str(tmp_path / "det.rec")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    truth = {}
+    for i in range(n):
+        img = rng.randint(0, 255, size=(32, 32, 3), dtype=np.uint8)
+        n_obj = rng.randint(1, 4)
+        objs = []
+        for _ in range(n_obj):
+            x0, y0 = rng.uniform(0, 0.5, 2)
+            x1 = x0 + rng.uniform(0.2, 0.5)
+            y1 = y0 + rng.uniform(0.2, 0.5)
+            objs.append([rng.randint(0, 3), x0, y0, min(x1, 1), min(y1, 1)])
+        label = np.concatenate([[2, 5], np.asarray(objs).ravel()]) \
+            .astype(np.float32)
+        truth[i] = np.asarray(objs, np.float32)
+        header = recordio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, img_fmt=".png",
+                                           quality=3))
+    rec.close()
+    return rec_path, idx_path, truth
+
+
+def test_det_iter_shapes_and_padding(tmp_path):
+    rec_path, idx_path, truth = _make_det_rec(tmp_path)
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                            path_imgrec=rec_path, path_imgidx=idx_path,
+                            seed=0)
+    max_objs = max(len(v) for v in truth.values())
+    assert it.provide_label[0].shape == (4, max_objs, 5)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (4, max_objs, 5)
+    # padded rows are -1; real rows have class >= 0 and valid corners
+    for row in lab.reshape(-1, 5):
+        if row[0] < 0:
+            assert (row == -1).all()
+        else:
+            assert 0 <= row[1] <= row[3] <= 1
+            assert 0 <= row[2] <= row[4] <= 1
+
+
+def test_det_iter_epoch_and_reset(tmp_path):
+    rec_path, idx_path, _ = _make_det_rec(tmp_path)
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=rec_path, path_imgidx=idx_path,
+                            seed=1)
+    n_batches = sum(1 for _ in it)
+    assert n_batches == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_det_flip_aug_flips_boxes():
+    rng = np.random.default_rng(0)
+    img = np.arange(4 * 4 * 3, dtype=np.float32).reshape(4, 4, 3)
+    boxes = np.array([[1, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    aug = image.DetHorizontalFlipAug(p=1.0, seed=0)
+    out_img, out_boxes = aug(img, boxes)
+    np.testing.assert_array_equal(out_img, img[:, ::-1])
+    np.testing.assert_allclose(out_boxes[0],
+                               [1, 1 - 0.4, 0.2, 1 - 0.1, 0.6], rtol=1e-6)
+    # involution: flipping twice restores the original
+    back_img, back_boxes = aug(out_img, out_boxes)
+    np.testing.assert_array_equal(back_img, img)
+    np.testing.assert_allclose(back_boxes, boxes, rtol=1e-6)
+
+
+def test_det_crop_aug_keeps_covered_objects():
+    rng_img = np.random.RandomState(0)
+    img = rng_img.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+    boxes = np.array([[0, 0.4, 0.4, 0.6, 0.6]], np.float32)  # centered box
+    aug = image.DetRandomCropAug(min_object_covered=0.5,
+                                 area_range=(0.5, 0.9), seed=3)
+    out_img, out_boxes = aug(img, boxes)
+    assert out_img.shape[0] <= 40 and out_img.shape[1] <= 40
+    if len(out_boxes):          # surviving boxes stay normalized and ordered
+        for row in out_boxes:
+            assert 0 <= row[1] <= row[3] <= 1
+            assert 0 <= row[2] <= row[4] <= 1
+
+
+def test_det_border_aug_shrinks_objects():
+    img = np.full((10, 10, 3), 200, np.uint8)
+    boxes = np.array([[2, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    aug = image.DetBorderAug(pad_ratio_range=(1.5, 1.5), fill=0, seed=0)
+    out_img, out_boxes = aug(img, boxes)
+    assert out_img.shape[0] == 15 and out_img.shape[1] == 15
+    w = out_boxes[0, 3] - out_boxes[0, 1]
+    h = out_boxes[0, 4] - out_boxes[0, 2]
+    np.testing.assert_allclose([w, h], [10 / 15, 10 / 15], rtol=1e-5)
+
+
+def test_det_iter_with_ssd_target():
+    """End-to-end: detection batches feed MultiBoxTarget (the SSD training
+    contract this iterator exists for)."""
+    from mxnet_tpu import ndarray as nd
+
+    rng = np.random.RandomState(5)
+    labels = np.full((2, 3, 5), -1, np.float32)
+    labels[0, 0] = [1, 0.1, 0.1, 0.5, 0.5]
+    labels[1, 0] = [0, 0.4, 0.4, 0.9, 0.9]
+    labels[1, 1] = [2, 0.0, 0.6, 0.3, 1.0]
+
+    anchors = nd.MultiBoxPrior(nd.array(rng.rand(1, 3, 8, 8).astype(np.float32)),
+                               sizes=[0.5, 0.25], ratios=[1, 2])
+    cls_preds = nd.array(rng.rand(2, 4, anchors.shape[1]).astype(np.float32))
+    out = nd.MultiBoxTarget(anchors, nd.array(labels), cls_preds)
+    loc_target, loc_mask, cls_target = out
+    assert cls_target.shape == (2, anchors.shape[1])
+    assert (cls_target.asnumpy() >= 0).all()
+
+
+def test_det_record_iter_prefetch(tmp_path):
+    rec_path, idx_path, _ = _make_det_rec(tmp_path)
+    it = image.ImageDetRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                                  data_shape=(3, 16, 16), batch_size=6,
+                                  seed=0)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (6, 3, 16, 16)
