@@ -240,12 +240,16 @@ class MNISTIter(DataIter):
             part = len(images) // num_parts
             images = images[part_index * part:(part_index + 1) * part]
             labels = labels[part_index * part:(part_index + 1) * part]
-        if flat:
-            images = images.reshape(len(images), -1)
-        else:
-            images = images.reshape(len(images), 1, 28, 28)
         if input_shape is not None:
             images = images.reshape((len(images),) + tuple(input_shape))
+        elif flat:
+            images = images.reshape(len(images), -1)
+        elif images.ndim == 3:
+            # idx images are (n, H, W); add the channel axis (iter_mnist.cc
+            # hardcodes 28x28 — here the file's own dims win)
+            images = images.reshape(len(images), 1, *images.shape[1:])
+        else:
+            images = images.reshape(len(images), 1, 28, 28)
         if shuffle:
             rng = np.random.RandomState(seed)
             idx = rng.permutation(len(images))

@@ -1,0 +1,147 @@
+"""Convergence-parity training tests on REAL data (sklearn's handwritten
+digits), the analog of the reference's tests/python/train/test_conv.py /
+test_mlp.py which train to an accuracy threshold on MNIST.
+
+Also exercises MNISTIter's real idx-file path (iter_mnist.cc analog) by
+writing the dataset in MNIST idx format first.
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import MNISTIter
+
+sklearn = pytest.importorskip("sklearn.datasets")
+
+
+def _write_idx_images(path, images):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">i", 0x00000803))       # magic: ubyte, 3 dims
+        for d in images.shape:
+            f.write(struct.pack(">i", d))
+        f.write(images.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, labels):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">i", 0x00000801))       # magic: ubyte, 1 dim
+        f.write(struct.pack(">i", len(labels)))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+@pytest.fixture(scope="module")
+def digits_idx(tmp_path_factory):
+    """sklearn digits written as MNIST idx files, split train/val."""
+    d = sklearn.load_digits()
+    images = (d.images * (255.0 / 16.0)).astype(np.uint8)    # 0..16 -> 0..255
+    labels = d.target.astype(np.uint8)
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(images))
+    images, labels = images[order], labels[order]
+    n_train = 1500
+    root = tmp_path_factory.mktemp("digits")
+    paths = {}
+    for split, sl in (("train", slice(None, n_train)),
+                      ("val", slice(n_train, None))):
+        img_path = str(root / ("%s-images-idx3-ubyte" % split))
+        lab_path = str(root / ("%s-labels-idx1-ubyte" % split))
+        _write_idx_images(img_path, images[sl])
+        _write_idx_labels(lab_path, labels[sl])
+        paths[split] = (img_path, lab_path)
+    return paths
+
+
+def _lenet():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                          name="c1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                          name="c2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=64, name="f1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="f2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_conv_net_converges_on_real_digits(digits_idx):
+    """LeNet-style conv net reaches >=0.95 held-out accuracy on real
+    handwritten digits (reference threshold: test_conv.py asserts 0.93 on
+    MNIST)."""
+    train_img, train_lab = digits_idx["train"]
+    val_img, val_lab = digits_idx["val"]
+    train = MNISTIter(image=train_img, label=train_lab, batch_size=50,
+                      input_shape=(1, 8, 8), seed=1)
+    val = MNISTIter(image=val_img, label=val_lab, batch_size=50,
+                    input_shape=(1, 8, 8), shuffle=False)
+
+    mod = mx.mod.Module(_lenet(), context=mx.cpu())
+    mod.fit(train, eval_data=val,
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            num_epoch=10)
+    score = dict(mod.score(val, "acc"))
+    assert score["accuracy"] >= 0.95, score
+
+
+def test_mlp_converges_on_real_digits(digits_idx):
+    """MLP analog of test_mlp.py: flat input, >=0.92 held-out accuracy."""
+    train_img, train_lab = digits_idx["train"]
+    val_img, val_lab = digits_idx["val"]
+    train = MNISTIter(image=train_img, label=train_lab, batch_size=50,
+                      flat=True, seed=1)
+    val = MNISTIter(image=val_img, label=val_lab, batch_size=50, flat=True,
+                    shuffle=False)
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, initializer=mx.initializer.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            num_epoch=10)
+    score = dict(mod.score(val, "acc"))
+    assert score["accuracy"] >= 0.92, score
+
+
+def test_checkpoint_resume_preserves_convergence(digits_idx, tmp_path):
+    """Training resumed from an epoch checkpoint matches uninterrupted
+    training's accuracy (reference: base_module begin_epoch resume)."""
+    train_img, train_lab = digits_idx["train"]
+    val_img, val_lab = digits_idx["val"]
+
+    def make_iters():
+        return (MNISTIter(image=train_img, label=train_lab, batch_size=50,
+                          input_shape=(1, 8, 8), seed=1),
+                MNISTIter(image=val_img, label=val_lab, batch_size=50,
+                          input_shape=(1, 8, 8), shuffle=False))
+
+    prefix = str(tmp_path / "ck")
+    train, val = make_iters()
+    mod = mx.mod.Module(_lenet(), context=mx.cpu())
+    mod.fit(train, initializer=mx.initializer.Xavier(), optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3}, num_epoch=4,
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+
+    # resume at epoch 4 and continue to 8
+    train, val = make_iters()
+    resumed = mx.mod.Module(_lenet(), context=mx.cpu())
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, 4)
+    resumed.fit(train, arg_params=arg_params, aux_params=aux_params,
+                optimizer="adam",
+                optimizer_params={"learning_rate": 2e-3},
+                begin_epoch=4, num_epoch=8)
+    score = dict(resumed.score(val, "acc"))
+    assert score["accuracy"] >= 0.95, score
