@@ -89,7 +89,9 @@ class DataParallelExecutorGroup:
         self._mesh = None
         self._data_sharding = None
         self._rep_sharding = None
+        self._input_shardings = {}
         self._model_par = 1
+        self._seq_par = 1
         # params (and their aux/grads) eligible for tensor-parallel
         # annotation; inputs/labels never are
         self._tp_param_names = set(self.param_names) | set(self.aux_names)
@@ -113,14 +115,33 @@ class DataParallelExecutorGroup:
             from ..parallel.mesh import build_mesh
 
             self._mesh = build_mesh(self.mesh_config, devices)
-            self._model_par = dict(zip(self.mesh_config.names,
-                                       self.mesh_config.resolve(
-                                           len(devices))))["model"]
+            axis_sizes = dict(zip(self.mesh_config.names,
+                                  self.mesh_config.resolve(len(devices))))
+            self._model_par = axis_sizes["model"]
+            self._seq_par = axis_sizes.get("seq", 1)
         else:
             self._mesh = Mesh(np.array(devices), ("data",))
             self._model_par = 1
+            self._seq_par = 1
         self._data_sharding = NamedSharding(self._mesh, P("data"))
         self._rep_sharding = NamedSharding(self._mesh, P())
+        # per-input shardings from the DataDesc layouts, fixed at bind time:
+        # the batch axis (N) shards on 'data'; with seq>1 the time axis (T)
+        # shards on 'seq' — sequence/context parallelism, GSPMD inserting
+        # the collectives (leapfrogs SURVEY §2.5 'Sequence-length scaling':
+        # the reference buckets, the TPU build shards time)
+        self._input_shardings = {}
+        for desc in self.data_shapes + (self.label_shapes or []):
+            layout = getattr(desc, "layout", None) or ""
+            if self._seq_par > 1 and "T" in layout and "N" in layout:
+                spec = [None] * len(desc.shape)
+                spec[layout.index("N")] = "data"
+                spec[layout.index("T")] = "seq"
+                self._input_shardings[desc.name] = \
+                    NamedSharding(self._mesh, P(*spec))
+
+    def _input_sharding(self, name):
+        return self._input_shardings.get(name, self._data_sharding)
 
     def _param_sharding(self, name, shape):
         """Tensor-parallel sharding rule over the 'model' mesh axis.
@@ -150,7 +171,8 @@ class DataParallelExecutorGroup:
         if self._mesh is None:
             target = self.contexts[0].jax_device
         elif sharded:
-            target = self._data_sharding
+            target = self._input_sharding(name) if name is not None \
+                else self._data_sharding
         elif name is not None and self._model_par > 1 \
                 and name in self._tp_param_names:
             target = self._param_sharding(name, arr.shape)
@@ -231,14 +253,14 @@ class DataParallelExecutorGroup:
             dst = self.exec_.arg_dict[name]
             dst._set_data(arr.data.astype(dst.dtype) if arr.dtype != dst.dtype
                           else arr.data)
-            self._place(dst, sharded=True)
+            self._place(dst, sharded=True, name=name)
         if self.label_names and data_batch.label:
             for name, arr in zip(self.label_names, data_batch.label):
                 if name in self.exec_.arg_dict:
                     dst = self.exec_.arg_dict[name]
                     dst._set_data(arr.data.astype(dst.dtype)
                                   if arr.dtype != dst.dtype else arr.data)
-                    self._place(dst, sharded=True)
+                    self._place(dst, sharded=True, name=name)
 
     def _ensure_placement(self):
         """Re-pin params/grads/aux to the mesh (replicated).  Eager optimizer

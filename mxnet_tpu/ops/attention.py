@@ -1,0 +1,87 @@
+"""Attention operators — the long-context leapfrog.
+
+The reference (2017-era MXNet) has no attention op; its long-sequence story
+is bucketing + fused RNN (SURVEY §2.5 "Sequence-length scaling").  The TPU
+build upgrades that niche with first-class attention that composes with the
+mesh axes:
+
+* ``dot_product_attention`` — multi-head scaled-dot-product attention over
+  already-projected (B, T, E) tensors (compose MHA from FullyConnected +
+  this op, the framework's op-granularity convention).  Pure jnp einsum:
+  under the mesh executor, GSPMD partitions it over the ``seq`` axis from
+  the input shardings (all-gather/all-to-all sequence parallelism — the
+  Ulysses-style path) and over ``model`` for the head dimension.
+* For the explicit-collective path (memory-optimal long context), see
+  ``mxnet_tpu.parallel.ring.ring_attention`` — blockwise ring attention
+  with K/V rotating via ``lax.ppermute`` under ``shard_map``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..attrs import Param, ParamSchema
+from ..registry import OpDef, register_op, simple_compute
+
+
+def sdpa(q, k, v, num_heads=1, causal=False, scale=None):
+    """Multi-head scaled-dot-product attention kernel.
+
+    (B, Tq, E), (B, Tk, E), (B, Tk, Ev) -> (B, Tq, Ev).  The softmax runs
+    in float32 regardless of the input dtype (bf16-safe accumulation); the
+    output is cast back to the value dtype.  Shared by the registered op
+    and ``parallel.ring.dense_attention`` (one copy of the numerics).
+    """
+    import jax.numpy as jnp
+
+    b, tq, e = q.shape
+    tk = k.shape[1]
+    ev = v.shape[2]
+    assert e % num_heads == 0 and ev % num_heads == 0, \
+        "embed dim not divisible by num_heads"
+    hd = e // num_heads
+    qh = q.reshape(b, tq, num_heads, hd)
+    kh = k.reshape(b, tk, num_heads, hd)
+    vh = v.reshape(b, tk, num_heads, ev // num_heads)
+    scale = scale or 1.0 / np.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask[None, None], logits,
+                           jnp.finfo(jnp.float32).min)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhe->bqhe", p.astype(vh.dtype), vh)
+    return out.reshape(b, tq, ev)
+
+
+def _attn_shape(attrs, in_shapes, aux_shapes):
+    q, k, v = in_shapes
+    assert q[-1] == k[-1], "query/key embed dims differ"
+    assert k[0] == v[0] and k[1] == v[1], "key/value (B, T) differ"
+    out = (q[0], q[1], v[-1])
+    return [tuple(q), tuple(k), tuple(v)], [out], []
+
+
+def register_all():
+    def _compute(attrs, q, k, v):
+        return sdpa(q, k, v, num_heads=attrs.get("num_heads", 1),
+                    causal=attrs.get("causal", False),
+                    scale=attrs.get("scale", 0.0) or None)
+
+    register_op(OpDef(
+        "dot_product_attention", simple_compute(_compute),
+        schema=ParamSchema(
+            Param("num_heads", int, default=1),
+            Param("causal", bool, default=False),
+            Param("scale", float, default=0.0,
+                  doc="0 = 1/sqrt(head_dim)"),
+        ),
+        num_inputs=3, arguments=["query", "key", "value"],
+        infer_shape=_attn_shape,
+        doc="Multi-head scaled-dot-product attention over projected "
+            "(B, T, E) inputs.  Leapfrog op: no reference analog "
+            "(SURVEY §2.5 row 'Sequence-length scaling'); sequence "
+            "parallelism arrives via GSPMD seq-axis sharding or "
+            "parallel.ring.ring_attention."),
+        aliases=("_contrib_DotProductAttention",))

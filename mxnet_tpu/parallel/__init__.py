@@ -8,6 +8,9 @@ mesh.  `tools/launch.py` (dmlc-tracker ssh/mpi) becomes
 from . import collectives
 from .mesh import build_mesh, data_parallel_mesh, MeshConfig
 from . import launch
+from . import ring
+from .ring import ring_attention
+from . import health
 
 __all__ = ["collectives", "build_mesh", "data_parallel_mesh", "MeshConfig",
-           "launch"]
+           "launch", "ring", "ring_attention", "health"]

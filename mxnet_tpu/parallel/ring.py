@@ -1,0 +1,107 @@
+"""Ring attention — explicit-collective sequence parallelism.
+
+The memory-optimal long-context path (vs the GSPMD all-gather path the
+``dot_product_attention`` op gets from seq-axis input sharding): each
+device holds one sequence block of Q, K, V; K/V blocks rotate around the
+``seq`` mesh axis via ``lax.ppermute`` while each device accumulates its
+queries' attention over every block with streaming (log-sum-exp) softmax —
+flash-attention numerics, so no device ever materializes the full
+(T, T) score matrix or the full K/V.
+
+No reference analog (2017-era MXNet handles long sequences by bucketing;
+SURVEY §2.5) — this is the leapfrog path the SURVEY §7 north star names.
+
+Usage (under shard_map over a mesh with a ``seq`` axis):
+
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+        mesh=mesh,
+        in_specs=(P(None, "seq", None),) * 3,
+        out_specs=P(None, "seq", None),
+    )(q, k, v)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ring_attention", "dense_attention"]
+
+
+def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
+    """Single-device reference: the ``dot_product_attention`` op's own
+    kernel (one copy of the numerics — ``ops.attention.sdpa``)."""
+    import jax.numpy as jnp
+
+    from ..ops.attention import sdpa
+
+    return sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                num_heads=num_heads, causal=causal, scale=scale)
+
+
+def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
+                   scale=None):
+    """Blockwise ring attention over the ``axis_name`` mesh axis.
+
+    Args are the LOCAL sequence blocks (B, T_local, E).  Device i starts
+    with K/V block i; each of the ``n`` ring steps attends Q_local against
+    the currently-held K/V block, then rotates K/V to the next device with
+    ``lax.ppermute``.  A running (max, sum, acc) triple merges blocks with
+    exact flash-attention numerics, and causal masking uses the global
+    block offsets, so the result equals dense attention on the gathered
+    sequence.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, e = q.shape
+    hd = e // num_heads
+    ev = v.shape[2] // num_heads
+    scale = scale or 1.0 / np.sqrt(hd)
+
+    qh = q.reshape(b, t_local, num_heads, hd) * scale
+    kh = k.reshape(b, t_local, num_heads, hd)
+    vh = v.reshape(b, t_local, num_heads, ev)
+
+    # flash-attention accumulator state in fp32 (bf16-safe streaming sums)
+    neg_inf = jnp.finfo(jnp.float32).min
+    m0 = jnp.full((b, num_heads, t_local), neg_inf, jnp.float32)
+    l0 = jnp.zeros((b, num_heads, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, t_local, num_heads, ev), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, r):
+        m, l, acc, kb, vb = carry
+        # the K/V block currently held started at device (idx - r) mod n
+        src = (idx - r) % n
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kb).astype(jnp.float32)
+        if causal:
+            # global positions: queries idx*T+iq, keys src*T+ik
+            iq = idx * t_local + jnp.arange(t_local)
+            ik = src * t_local + jnp.arange(t_local)
+            mask = iq[:, None] >= ik[None, :]
+            logits = jnp.where(mask[None, None], logits, neg_inf)
+        blk_m = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, blk_m)
+        # guard fully-masked rows: exp(neg_inf - neg_inf) must stay 0
+        safe_new_m = jnp.where(new_m == neg_inf, 0.0, new_m)
+        correction = jnp.where(m == neg_inf, 0.0, jnp.exp(m - safe_new_m))
+        p = jnp.exp(logits - safe_new_m[..., None])
+        p = jnp.where(logits == neg_inf, 0.0, p)
+        new_l = l * correction + p.sum(-1)
+        new_acc = acc * correction.transpose(0, 2, 1)[..., None] + \
+            jnp.einsum("bhqk,bkhe->bqhe", p, vb.astype(jnp.float32))
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (new_m, new_l, new_acc, kb, vb), None
+
+    carry = (m0, l0, acc0, kh, vh)
+    for r in range(n):            # n is a static mesh size: unrolled ring
+        carry, _ = step(carry, r)
+    m, l, acc, _, _ = carry
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / denom.transpose(0, 2, 1)[..., None]).astype(v.dtype)
+    return out.reshape(b, t_local, v.shape[2])

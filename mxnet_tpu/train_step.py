@@ -255,7 +255,8 @@ class CompiledTrainStep:
         if dst is not None and v.dtype != dst.data.dtype:
             v = v.astype(dst.data.dtype)
         if group._mesh is not None:
-            return jax.device_put(v, group._data_sharding)
+            # per-input rule: honors seq-axis (time) sharding from layouts
+            return jax.device_put(v, group._input_sharding(name))
         return jax.device_put(v, group.contexts[0].jax_device)
 
     # ------------------------------------------------------------------

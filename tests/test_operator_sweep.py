@@ -740,6 +740,8 @@ TESTED_ELSEWHERE = {
     "normal": "test_random.py", "uniform": "test_random.py",
     "random_normal": "test_random.py", "random_uniform": "test_random.py",
     "_sum": "test_operator.py",   # registry alias of sum
+    "dot_product_attention": "test_seq_parallel.py",
+    "_contrib_DotProductAttention": "test_seq_parallel.py",
 }
 
 
