@@ -42,6 +42,52 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._optimizer = None
+        self._heartbeat = None
+        if kv_type.startswith("dist"):
+            self._start_heartbeat()
+            # reference parity: the dist store constructor rendezvouses all
+            # workers (kvstore_dist.h:39) — unless this is a restarted
+            # worker, whose peers are already past it
+            self.barrier(startup=True)
+
+    def _start_heartbeat(self):
+        """Liveness stamps for failure detection (ps-lite heartbeat analog;
+        see parallel.health).  Enabled by MXNET_HEARTBEAT_DIR — a directory
+        every worker can reach.  One stamping thread per process however
+        many dist stores exist; close() stops it."""
+        import os
+
+        directory = os.environ.get("MXNET_HEARTBEAT_DIR")
+        if not directory:
+            return
+        from .parallel import health
+
+        self._heartbeat = health.ensure_heartbeat(directory, self.rank)
+
+    def close(self):
+        """Stop this process's heartbeat (process-wide — affects every dist
+        store sharing it)."""
+        if self._heartbeat is not None:
+            from .parallel import health
+
+            health.stop_heartbeat(self._heartbeat.directory,
+                                  self._heartbeat.rank)
+            self._heartbeat = None
+
+    def num_dead_node(self, node_id=0, timeout=None):
+        """Count of workers with stale/missing heartbeats
+        (reference: kvstore.h:235-244 get_num_dead_node; requires
+        MXNET_HEARTBEAT_DIR, else 0)."""
+        import os
+
+        directory = os.environ.get("MXNET_HEARTBEAT_DIR")
+        if not directory or not self._type.startswith("dist"):
+            return 0
+        from .parallel import health
+
+        return health.num_dead_nodes(
+            directory, self.num_workers,
+            timeout if timeout is not None else health.DEFAULT_TIMEOUT)
 
     # -- core API ----------------------------------------------------------
     def init(self, key, value):
@@ -135,7 +181,15 @@ class KVStore:
 
         return jax.process_count() if self._type.startswith("dist") else 1
 
-    def barrier(self):
+    def barrier(self, startup=False):
+        """Global barrier.  A restarted worker (MXNET_IS_RECOVERY=1) skips
+        STARTUP barriers only — the peers it would rendezvous with are past
+        them (reference: kvstore_dist.h:39,77 is_recovery branches)."""
+        if startup:
+            from .parallel.health import is_recovery
+
+            if is_recovery():
+                return
         if self.num_workers > 1:
             from .parallel import collectives
 

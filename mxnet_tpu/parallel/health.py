@@ -1,0 +1,139 @@
+"""Worker failure detection — the ps-lite heartbeat analog.
+
+The reference's elastic story (SURVEY §5 "Failure detection"): ps-lite
+heartbeats surface ``get_num_dead_node`` (include/mxnet/kvstore.h:235-244),
+restarted workers set ``is_recovery`` to skip the startup barrier
+(kvstore_dist.h:39,77), and recovery itself is manual resume from epoch
+checkpoints.  The TPU build keeps exactly that surface: a heartbeat
+registry over a shared directory (local disk for single-host multi-process,
+NFS/GCS-fuse for pods), ``num_dead_nodes``, and ``is_recovery`` from the
+environment (``MXNET_IS_RECOVERY``, matching the reference's
+``DMLC_PS_VAN_START`` recovery flag in spirit).
+
+XLA collectives are synchronous: a dead worker stalls the next collective
+rather than corrupting state, so detection's job is to let the launcher /
+training loop notice and restart from the last checkpoint — the same
+recovery contract as the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["Heartbeat", "ensure_heartbeat", "stop_heartbeat",
+           "num_dead_nodes", "dead_nodes", "is_recovery",
+           "DEFAULT_INTERVAL", "DEFAULT_TIMEOUT"]
+
+DEFAULT_INTERVAL = 2.0     # seconds between stamps
+DEFAULT_TIMEOUT = 10.0     # stale-after threshold (ps-lite heartbeat
+                           # timeout is likewise a few intervals)
+
+
+def _stamp_path(directory, rank):
+    return os.path.join(directory, "worker-%d.heartbeat" % rank)
+
+
+class Heartbeat:
+    """Periodic liveness stamp for one worker process.
+
+    Start on worker startup (the dist KVStore does this automatically when
+    ``MXNET_HEARTBEAT_DIR`` is set); the daemon thread rewrites this rank's
+    stamp file every ``interval`` seconds.
+    """
+
+    def __init__(self, directory, rank, interval=DEFAULT_INTERVAL):
+        self.directory = directory
+        self.rank = rank
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+        os.makedirs(directory, exist_ok=True)
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self.beat()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="mxtpu-heartbeat-%d" % self.rank)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        """Write one stamp (atomic rename so readers never see a torn
+        file)."""
+        path = _stamp_path(self.directory, self.rank)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "time": time.time(),
+                       "pid": os.getpid()}, f)
+        os.replace(tmp, path)
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.beat()
+            except OSError:
+                pass  # shared dir hiccup; next beat retries
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1)
+            self._thread = None
+
+
+# one stamping thread per (dir, rank) per process, however many KVStores
+# are created over it; stop_heartbeat ends it process-wide
+_active = {}
+_active_lock = threading.Lock()
+
+
+def ensure_heartbeat(directory, rank, interval=DEFAULT_INTERVAL):
+    """The process-wide heartbeat for (directory, rank), started on first
+    use and shared by every dist KVStore."""
+    key = (os.path.abspath(directory), rank)
+    with _active_lock:
+        hb = _active.get(key)
+        if hb is None:
+            hb = Heartbeat(directory, rank, interval).start()
+            _active[key] = hb
+        return hb
+
+
+def stop_heartbeat(directory, rank):
+    """Stop (and forget) the process-wide heartbeat for (directory, rank)."""
+    key = (os.path.abspath(directory), rank)
+    with _active_lock:
+        hb = _active.pop(key, None)
+    if hb is not None:
+        hb.stop()
+
+
+def dead_nodes(directory, num_workers, timeout=DEFAULT_TIMEOUT, now=None):
+    """Ranks considered dead: stamp missing or older than ``timeout``.
+    (``get_num_dead_node(node_id, timeout)`` analog, kvstore.h:235-244.)"""
+    now = time.time() if now is None else now
+    dead = []
+    for rank in range(num_workers):
+        path = _stamp_path(directory, rank)
+        try:
+            with open(path) as f:
+                stamp = json.load(f)
+            if now - stamp["time"] > timeout:
+                dead.append(rank)
+        except (OSError, ValueError, KeyError):
+            dead.append(rank)
+    return dead
+
+
+def num_dead_nodes(directory, num_workers, timeout=DEFAULT_TIMEOUT):
+    return len(dead_nodes(directory, num_workers, timeout))
+
+
+def is_recovery():
+    """Whether this worker is a restart (skip startup-only work like the
+    initial barrier — kvstore_dist.h:39,77 ``is_recovery`` branches)."""
+    return os.environ.get("MXNET_IS_RECOVERY", "0") not in ("", "0",
+                                                            "false", "False")

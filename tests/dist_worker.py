@@ -56,4 +56,22 @@ want = 10.0 - 0.5 * sum(r + 1 for r in range(nprocs))
 np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
 kv2.barrier()
 
+# -- failure detection: every worker's heartbeat is fresh ------------------
+import os as _os
+
+if _os.environ.get("MXNET_HEARTBEAT_DIR"):
+    import time as _time
+
+    kv.barrier()                 # all workers have created their stamps
+    _time.sleep(0.1)
+    assert kv.num_dead_node() == 0, \
+        "live workers misreported dead: %d" % kv.num_dead_node()
+    # a rank beyond the group has no stamp -> detected
+    from mxnet_tpu.parallel import health
+
+    dead = health.dead_nodes(_os.environ["MXNET_HEARTBEAT_DIR"],
+                             nprocs + 1)
+    assert dead == [nprocs], dead
+    kv.barrier()
+
 print("WORKER_%d_OK" % rank, flush=True)

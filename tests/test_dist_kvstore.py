@@ -24,11 +24,12 @@ def _free_port():
 
 
 @pytest.mark.parametrize("nprocs", [2])
-def test_dist_sync_kvstore_two_processes(nprocs):
+def test_dist_sync_kvstore_two_processes(nprocs, tmp_path):
     coordinator = "localhost:%d" % _free_port()
     env = dict(os.environ)
     # the workers pin their own platform; scrub the test session's flags
     env.pop("XLA_FLAGS", None)
+    env["MXNET_HEARTBEAT_DIR"] = str(tmp_path / "hb")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(

@@ -1,0 +1,104 @@
+"""Failure detection tests (parallel.health — the ps-lite heartbeat analog,
+reference include/mxnet/kvstore.h:235-244, kvstore_dist.h:39,77)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import health
+
+
+def test_heartbeat_stamps_and_liveness(tmp_path):
+    d = str(tmp_path)
+    hb = health.Heartbeat(d, rank=0, interval=0.05).start()
+    try:
+        time.sleep(0.15)
+        assert health.num_dead_nodes(d, num_workers=1, timeout=1.0) == 0
+        # rank 1 never stamped -> dead
+        assert health.dead_nodes(d, num_workers=2, timeout=1.0) == [1]
+    finally:
+        hb.stop()
+
+
+def test_stale_heartbeat_detected(tmp_path):
+    d = str(tmp_path)
+    hb = health.Heartbeat(d, rank=0)
+    hb.beat()
+    # fresh now...
+    assert health.num_dead_nodes(d, 1, timeout=5.0) == 0
+    # ...but judged dead from a future clock (deterministic staleness)
+    future = time.time() + 60
+    assert health.dead_nodes(d, 1, timeout=5.0, now=future) == [0]
+
+
+def test_corrupt_stamp_counts_dead(tmp_path):
+    d = str(tmp_path)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "worker-0.heartbeat"), "w") as f:
+        f.write("not json")
+    assert health.dead_nodes(d, 1) == [0]
+
+
+def test_heartbeat_restart_overwrites(tmp_path):
+    """A restarted worker reclaims its rank file (new pid)."""
+    d = str(tmp_path)
+    health.Heartbeat(d, rank=3).beat()
+    health.Heartbeat(d, rank=3).beat()
+    with open(os.path.join(d, "worker-3.heartbeat")) as f:
+        stamp = json.load(f)
+    assert stamp["rank"] == 3 and stamp["pid"] == os.getpid()
+    assert health.dead_nodes(d, 4, timeout=5.0) == [0, 1, 2]
+
+
+def test_is_recovery_env(monkeypatch):
+    monkeypatch.delenv("MXNET_IS_RECOVERY", raising=False)
+    assert not health.is_recovery()
+    monkeypatch.setenv("MXNET_IS_RECOVERY", "1")
+    assert health.is_recovery()
+    monkeypatch.setenv("MXNET_IS_RECOVERY", "0")
+    assert not health.is_recovery()
+
+
+def test_kvstore_num_dead_node(tmp_path, monkeypatch):
+    """KVStore surfaces the count (get_num_dead_node parity) and starts its
+    own heartbeat for dist stores."""
+    monkeypatch.setenv("MXNET_HEARTBEAT_DIR", str(tmp_path))
+    kv = mx.kvstore.create("dist_sync")
+    try:
+        assert kv._heartbeat is not None
+        # a second dist store shares the SAME process-wide heartbeat thread
+        kv2 = mx.kvstore.create("dist_sync")
+        assert kv2._heartbeat is kv._heartbeat
+        # single process: rank 0 alive, so none dead
+        assert kv.num_dead_node() == 0
+        # local store never reports dead nodes
+        local = mx.kvstore.create("local")
+        assert local.num_dead_node() == 0
+        assert local._heartbeat is None
+    finally:
+        kv.close()
+    assert kv._heartbeat is None
+
+
+def test_startup_barrier_skipped_on_recovery(monkeypatch):
+    """A recovering worker must not block on the startup barrier."""
+    calls = []
+    from mxnet_tpu.parallel import collectives
+
+    monkeypatch.setattr(collectives, "barrier",
+                        lambda: calls.append("barrier"))
+    kv = mx.kvstore.create("dist_sync")
+    monkeypatch.setattr(type(kv), "num_workers",
+                        property(lambda self: 2))
+
+    monkeypatch.setenv("MXNET_IS_RECOVERY", "1")
+    kv.barrier(startup=True)      # skipped
+    assert calls == []
+    kv.barrier()                  # normal barriers still run
+    assert calls == ["barrier"]
+    monkeypatch.setenv("MXNET_IS_RECOVERY", "0")
+    kv.barrier(startup=True)      # fresh start: startup barrier runs
+    assert calls == ["barrier", "barrier"]

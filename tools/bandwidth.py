@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Communication bandwidth probe — `tools/bandwidth/measure.py` analog.
+
+The reference measures ps-lite push/pull cost per batch; the TPU analog
+measures what actually moves bytes here:
+
+* host -> device transfer (infeed) bandwidth,
+* device-to-device all-reduce (psum over the 'data' mesh axis — rides ICI
+  on a real multi-chip mesh, shared memory on the virtual CPU mesh),
+* all-gather over the same axis.
+
+Run:  python tools/bandwidth.py [--devices N] [--sizes MB,MB,...]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _time(fn, *args, iters=5):
+    import jax
+
+    fn(*args)                      # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (0 = real)")
+    ap.add_argument("--sizes", default="1,16,64,256",
+                    help="payload sizes in MiB")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("data",))
+    rep = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("data"))
+    print("devices: %d x %s" % (n, devices[0].device_kind), flush=True)
+
+    sizes_mb = [float(s) for s in args.sizes.split(",")]
+    for mb in sizes_mb:
+        elems = int(mb * 2 ** 20 / 4)
+        elems -= elems % max(n, 1)
+        host = np.random.RandomState(0).rand(elems).astype(np.float32)
+        nbytes = host.nbytes
+
+        # host -> device
+        t = _time(lambda h: jax.device_put(h, devices[0]), host)
+        h2d = nbytes / t / 1e9
+
+        # all-reduce: sharded input, psum'd (replicated) output
+        @jax.jit
+        def allreduce(x):
+            return jax.lax.with_sharding_constraint(
+                x * 1.0, rep)
+
+        x = jax.device_put(host, shard)
+        t = _time(allreduce, x)
+        ar = nbytes / t / 1e9
+
+        # all-gather: sharded -> replicated concat
+        @jax.jit
+        def allgather(x):
+            return jax.lax.with_sharding_constraint(x, rep)
+
+        t = _time(allgather, x)
+        ag = nbytes / t / 1e9
+
+        print("%8.1f MiB | h2d %7.2f GB/s | all-reduce %7.2f GB/s | "
+              "all-gather %7.2f GB/s" % (mb, h2d, ar, ag), flush=True)
+
+
+if __name__ == "__main__":
+    main()
