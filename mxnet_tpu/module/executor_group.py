@@ -90,8 +90,10 @@ class DataParallelExecutorGroup:
         self._data_sharding = None
         self._rep_sharding = None
         self._input_shardings = {}
+        self._param_mesh_axes = {}
         self._model_par = 1
         self._seq_par = 1
+        self._expert_par = 1
         # params (and their aux/grads) eligible for tensor-parallel
         # annotation; inputs/labels never are
         self._tp_param_names = set(self.param_names) | set(self.aux_names)
@@ -119,10 +121,12 @@ class DataParallelExecutorGroup:
                                   self.mesh_config.resolve(len(devices))))
             self._model_par = axis_sizes["model"]
             self._seq_par = axis_sizes.get("seq", 1)
+            self._expert_par = axis_sizes.get("expert", 1)
         else:
             self._mesh = Mesh(np.array(devices), ("data",))
             self._model_par = 1
             self._seq_par = 1
+            self._expert_par = 1
         self._data_sharding = NamedSharding(self._mesh, P("data"))
         self._rep_sharding = NamedSharding(self._mesh, P())
         # per-input shardings from the DataDesc layouts, fixed at bind time:
@@ -139,6 +143,20 @@ class DataParallelExecutorGroup:
                 spec[layout.index("T")] = "seq"
                 self._input_shardings[desc.name] = \
                     NamedSharding(self._mesh, P(*spec))
+        # op-declared param mesh axes (OpDef.mesh_axes, e.g. MoE expert
+        # stacks): walk the graph once and map each variable that feeds such
+        # an argument to its axis
+        axis_sizes = dict(self._mesh.shape)
+        self._param_mesh_axes = {}
+        for node in self.symbol._topo():
+            if node.is_variable or not node.op.mesh_axes:
+                continue
+            arg_names = node.op.list_arguments(node.parsed_attrs())
+            for (inode, _), arg in zip(node.inputs, arg_names):
+                axis = node.op.mesh_axes.get(arg)
+                if axis and inode.is_variable \
+                        and axis_sizes.get(axis, 1) > 1:
+                    self._param_mesh_axes[inode.name] = axis
 
     def _input_sharding(self, name):
         return self._input_shardings.get(name, self._data_sharding)
@@ -155,6 +173,13 @@ class DataParallelExecutorGroup:
         """
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # op-declared axes first (OpDef.mesh_axes — e.g. MoE expert stacks
+        # shard dim 0 on 'expert'); graph metadata, not name matching
+        axis = self._param_mesh_axes.get(name)
+        if axis is not None and shape \
+                and shape[0] % dict(self._mesh.shape)[axis] == 0:
+            return NamedSharding(
+                self._mesh, P(*([axis] + [None] * (len(shape) - 1))))
         if self._model_par <= 1 or not shape or \
                 shape[0] % self._model_par != 0:
             return self._rep_sharding
@@ -173,7 +198,8 @@ class DataParallelExecutorGroup:
         elif sharded:
             target = self._input_sharding(name) if name is not None \
                 else self._data_sharding
-        elif name is not None and self._model_par > 1 \
+        elif name is not None and (self._model_par > 1
+                                   or self._param_mesh_axes) \
                 and name in self._tp_param_names:
             target = self._param_sharding(name, arr.shape)
         else:

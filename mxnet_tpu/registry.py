@@ -72,6 +72,7 @@ class OpDef:
         hint=None,
         doc="",
         visible=True,
+        mesh_axes=None,
     ):
         self.name = name
         self.fcompute = fcompute
@@ -90,6 +91,11 @@ class OpDef:
         self.hint = hint or name.lstrip("_").lower()
         self.doc = doc
         self.visible = visible
+        # {argument_name: mesh_axis} — weights whose leading dim belongs on
+        # a named mesh axis (e.g. MoE expert stacks on 'expert'); the mesh
+        # executor reads this to shard the bound variables (op-level
+        # metadata, not parameter-name matching)
+        self.mesh_axes = dict(mesh_axes or {})
 
     # -- introspection -----------------------------------------------------
     def n_inputs(self, attrs):

@@ -742,6 +742,7 @@ TESTED_ELSEWHERE = {
     "_sum": "test_operator.py",   # registry alias of sum
     "dot_product_attention": "test_seq_parallel.py",
     "_contrib_DotProductAttention": "test_seq_parallel.py",
+    "MoEFFN": "test_moe.py", "_contrib_MoEFFN": "test_moe.py",
 }
 
 
