@@ -10,7 +10,10 @@ from .mesh import build_mesh, data_parallel_mesh, MeshConfig
 from . import launch
 from . import ring
 from .ring import ring_attention
+from . import pipeline
+from .pipeline import pipeline_apply, stack_stage_params
 from . import health
 
 __all__ = ["collectives", "build_mesh", "data_parallel_mesh", "MeshConfig",
-           "launch", "ring", "ring_attention", "health"]
+           "launch", "ring", "ring_attention", "pipeline", "pipeline_apply",
+           "stack_stage_params", "health"]

@@ -1,0 +1,104 @@
+"""Pipeline parallelism — GPipe-style microbatching over the 'pipe' axis.
+
+The reference's "pipeline" is emergent: ``group2ctx`` places layer blocks
+on different devices and the async engine overlaps them
+(docs/how_to/model_parallel_lstm.md) — no microbatching, so bubbles are
+full-stage.  This module is the leapfrog: an explicit software pipeline
+under ``shard_map`` where each device owns ONE stage's weights and
+microbatches flow device-to-device via ``lax.ppermute``.
+
+The schedule is the classic GPipe fill-drain: with S stages and M
+microbatches, step s ∈ [0, M+S-1) has device d working on microbatch
+s - d (when valid).  Activations move one hop per step.  Because the
+whole schedule is a differentiable ``lax.scan`` over ``ppermute``,
+``jax.grad`` of a pipelined loss yields the reverse pipeline
+automatically — no hand-written backward schedule.
+
+Constraint (standard for this primitive): every stage maps activations of
+one fixed shape to the same shape (stack projection layers inside a stage
+if widths change at its boundary).
+"""
+from __future__ import annotations
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees into one pytree with a leading
+    stage axis — the array you shard on the 'pipe' mesh axis."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches):
+    """Run a stage-per-device pipeline; call under ``shard_map``.
+
+    Args:
+      stage_fn: ``(params, activation) -> activation`` for ONE stage.
+      stage_params: this device's slice of the stage-stacked params — under
+        ``shard_map`` with ``P('pipe', ...)`` in_spec each device receives a
+        leading dim of 1; it is squeezed before calling ``stage_fn``.
+      x: the full (replicated) batch, microbatched on axis 0:
+        shape (num_microbatches, mb_size, ...).  Stage 0 consumes it.
+      axis_name: the pipeline mesh axis.
+      num_microbatches: M; the schedule runs M + S - 1 steps.
+
+    Returns the pipelined output (M, mb_size, ...), replicated (the last
+    stage's results are psum-broadcast so every device returns them).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = num_microbatches
+    assert x.shape[0] == m, "x must be microbatched: (M, mb, ...)"
+
+    params = jax.tree_util.tree_map(
+        lambda p: p.reshape(p.shape[1:]) if p.shape[0] == 1 else p,
+        stage_params)
+
+    def probe(mb):
+        return jax.eval_shape(lambda p, a: stage_fn(p, a), params, mb)
+
+    out_sd = probe(jax.eval_shape(lambda v: v[0], x))
+    assert tuple(out_sd.shape) == tuple(x.shape[1:]), \
+        "stage_fn must preserve the activation shape (got %s from %s)" % (
+            out_sd.shape, x.shape[1:])
+
+    steps = m + n - 1
+    # carries become device-varying over the pipe axis inside the scan, so
+    # the initial values must be marked varying too (shard_map vma typing);
+    # zeros_like inherits whatever axes x already varies over (e.g. 'data')
+    state0 = lax.pcast(jnp.zeros_like(x[0]), (axis_name,), to="varying")
+    buf0 = lax.pcast(jnp.zeros_like(x), (axis_name,), to="varying")
+
+    def step(carry, s):
+        state, buf = carry
+        # stage 0 ingests microbatch s; later stages take the handed-off
+        # activation.  Invalid (bubble) slots compute on zeros — wasted
+        # FLOPs in the bubble, matching GPipe.
+        mb = x[jnp.clip(s, 0, m - 1)]
+        inp = jnp.where(idx == 0, mb, state)
+        out = stage_fn(params, inp)
+        # microbatch id at this device this step: s - idx, valid in [0, m)
+        mb_id = s - idx
+        valid = jnp.logical_and(mb_id >= 0, mb_id < m)
+        # last stage records its result
+        write = jnp.logical_and(valid, idx == n - 1)
+        pos = jnp.clip(mb_id, 0, m - 1)
+        buf = lax.dynamic_update_index_in_dim(
+            buf, jnp.where(write, out, buf[pos]), pos, 0)
+        # hand off to the next stage
+        nxt = lax.ppermute(out, axis_name,
+                           [(i, (i + 1) % n) for i in range(n)])
+        return (nxt, buf), None
+
+    (_, buf), _ = lax.scan(step, (state0, buf0), jnp.arange(steps))
+    # broadcast the last stage's buffer to every device
+    buf = jnp.where(idx == n - 1, buf, jnp.zeros_like(buf))
+    return lax.psum(buf, axis_name)
