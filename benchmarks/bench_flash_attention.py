@@ -1,0 +1,68 @@
+"""Flash-attention kernel vs einsum attention on the real chip.
+
+The einsum path materializes (B*H, T, T) fp32 logits in HBM; the Pallas
+kernel streams them through VMEM.  Long-context inference is where that
+flips from convenience to necessity:  python benchmarks/bench_flash_attention.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_attention as pa
+    from mxnet_tpu.ops.attention import sdpa
+
+    on_tpu = jax.default_backend() == "tpu"
+    print("backend:", jax.default_backend())
+    b, heads, d = 4, 8, 128
+    e = heads * d
+
+    for t in (1024, 2048, 4096, 8192):
+        rng = np.random.RandomState(0)
+        q, k, v = [jnp.asarray(rng.normal(size=(b, t, e)), jnp.bfloat16)
+                   for _ in range(3)]
+
+        ein = jax.jit(lambda q_, k_, v_: sdpa(q_, k_, v_, num_heads=heads,
+                                              causal=True))
+        fla = jax.jit(lambda q_, k_, v_: pa.sdpa_flash(
+            q_, k_, v_, num_heads=heads, causal=True, scale=None,
+            interpret=not on_tpu))
+
+        def bench(fn):
+            out = fn(q, k, v)
+            jax.block_until_ready(out)
+            n = 10
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / n * 1e3
+
+        try:
+            ms_e = bench(ein)
+        except Exception as exc:       # einsum logits OOM HBM at long T
+            msg = "OOM" if "memory" in str(exc).lower() else "ERROR"
+            ms_f = bench(fla)
+            print("T=%5d | einsum %8s    | flash %8.2f ms | (flash runs "
+                  "where O(T^2) logits exceed HBM)" % (t, msg, ms_f),
+                  flush=True)
+            continue
+        ms_f = bench(fla)
+        err = float(jnp.max(jnp.abs(
+            ein(q, k, v).astype(jnp.float32)
+            - fla(q, k, v).astype(jnp.float32))))
+        print("T=%5d | einsum %8.2f ms | flash %8.2f ms | speedup %.2fx "
+              "| max|diff| %.3g"
+              % (t, ms_e, ms_f, ms_e / ms_f, err), flush=True)
+
+
+if __name__ == "__main__":
+    main()

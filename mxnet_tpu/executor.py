@@ -203,7 +203,9 @@ class Executor:
                 aux_ins = [jax.device_put(v, dev) for v in aux_ins]
                 if node_rng is not None:
                     node_rng = jax.device_put(node_rng, dev)
-            octx = OpContext(is_train=is_train, rng=node_rng)
+            octx = OpContext(is_train=is_train, rng=node_rng,
+                             mesh_active=getattr(self, "_mesh_active",
+                                                 False))
             with jax.named_scope(node.name):
                 if spans:
                     with _prof.Scope(node.name):

@@ -216,6 +216,9 @@ class DataParallelExecutorGroup:
         exec_ = Executor.simple_bind(self.symbol, ctx, grad_req=self.grad_req,
                                      type_dict=type_dict, shared_exec=shared_exec,
                                      **kwargs)
+        # ops with GSPMD-opaque fast paths (pallas kernels) must fall back
+        # when this executor's buffers are mesh-sharded
+        exec_._mesh_active = self._mesh is not None
         # shard data args on the mesh; params replicate (or shard on the
         # model axis under tensor parallelism), grads/aux follow their param
         for name, arr in exec_.arg_dict.items():

@@ -20,7 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..attrs import Param, ParamSchema
-from ..registry import OpDef, register_op, simple_compute
+from ..registry import OpDef, register_op
 
 
 def sdpa(q, k, v, num_heads=1, causal=False, scale=None):
@@ -64,13 +64,35 @@ def _attn_shape(attrs, in_shapes, aux_shapes):
 
 
 def register_all():
-    def _compute(attrs, q, k, v):
-        return sdpa(q, k, v, num_heads=attrs.get("num_heads", 1),
-                    causal=attrs.get("causal", False),
-                    scale=attrs.get("scale", 0.0) or None)
+    def _compute_full(attrs, inputs, aux, octx):
+        q, k, v = inputs
+        heads = attrs.get("num_heads", 1)
+        causal = attrs.get("causal", False)
+        scale = attrs.get("scale", 0.0) or None
+        from .. import config as _config
+
+        # inference-only, single-chip, TPU-only fast path:
+        #  - pallas_call is not differentiable -> training takes einsum;
+        #  - it is opaque to GSPMD -> mesh-sharded executors take einsum
+        #    (which the partitioner splits over 'seq'); explicit-collective
+        #    long context uses parallel.ring instead;
+        #  - on non-TPU backends interpret mode would be a slow emulation,
+        #    so they take einsum too.
+        if not octx.is_train and not octx.mesh_active \
+                and _config.get("MXNET_PALLAS_ATTENTION"):
+            from . import pallas_attention as _pa
+
+            import jax
+
+            if jax.default_backend() == "tpu" \
+                    and _pa.supported(q.shape, k.shape, causal):
+                out = _pa.sdpa_flash(q, k, v, heads, causal, scale)
+                return [out], []
+        return [sdpa(q, k, v, num_heads=heads, causal=causal,
+                     scale=scale)], []
 
     register_op(OpDef(
-        "dot_product_attention", simple_compute(_compute),
+        "dot_product_attention", _compute_full,
         schema=ParamSchema(
             Param("num_heads", int, default=1),
             Param("causal", bool, default=False),
@@ -78,7 +100,7 @@ def register_all():
                   doc="0 = 1/sqrt(head_dim)"),
         ),
         num_inputs=3, arguments=["query", "key", "value"],
-        infer_shape=_attn_shape,
+        infer_shape=_attn_shape, needs_train=True,
         doc="Multi-head scaled-dot-product attention over projected "
             "(B, T, E) inputs.  Leapfrog op: no reference analog "
             "(SURVEY §2.5 row 'Sequence-length scaling'); sequence "

@@ -1,0 +1,154 @@
+"""Pallas flash-attention forward kernel (TPU).
+
+The hot-op kernel the einsum formulation can't match at long sequence:
+``ops.attention.sdpa`` materializes the (T, T) logits in HBM — O(T²)
+memory traffic — while this kernel streams K/V blocks through VMEM with a
+running (max, sum, acc) softmax, O(T) memory, logits never leaving the
+chip (flash-attention schedule; same numerics as the streaming
+accumulator in ``parallel/ring.py``, here at the kernel level).
+
+Used by ``dot_product_attention`` when ``MXNET_PALLAS_ATTENTION`` enables
+it and shapes divide the block size; anything else falls back to the
+einsum path.  ``interpret=True`` runs the same kernel on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            scale, causal, block_q, block_k):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _update():
+        q = q_ref[0]                                # (BQ, D)
+        k = k_ref[0]                                # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kj = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s_masked = jnp.where(qi >= kj, s, -jnp.inf)
+        else:
+            s_masked = s
+        s = s_masked
+
+        m_prev = m_scr[:, :1]                       # (BQ, 1)
+        blk_m = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_m)
+        # rows with every key masked so far keep m = -inf; normalize safely
+        m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(s == -jnp.inf, 0.0, p)
+        corr = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - m_safe))
+
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = acc
+
+    if causal:
+        # skip K/V blocks entirely above the diagonal (~2x on long T)
+        @pl.when(j * block_k <= i * block_q + block_q - 1)
+        def _masked_update():
+            _update()
+    else:
+        _update()
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        denom = l_scr[:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, scale, causal=False, interpret=False):
+    """(BH, T, D) q/k/v -> (BH, T, D) attention output.
+
+    T must divide BLOCK_Q/BLOCK_K (the caller checks and falls back)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    bq = min(BLOCK_Q, t)
+    bk = min(BLOCK_K, t)
+    grid = (bh, t // bq, t // bk)
+
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running sum
+            pltpu.VMEM((bq, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def supported(q_shape, k_shape, causal):
+    """Whether the kernel handles these shapes (self-attention, block-
+    divisible T, lane-friendly head dim)."""
+    bh, tq, d = q_shape
+    tk = k_shape[1]
+    if tq != tk:                       # cross-attention: fallback
+        return False
+    if tq % BLOCK_Q or tq % BLOCK_K:   # block-divisible T only
+        return False
+    if d % 64 != 0:                    # lane-unfriendly heads: fallback
+        return False
+    return True
+
+
+def sdpa_flash(q, k, v, num_heads, causal, scale, interpret=False):
+    """Multi-head wrapper matching ops.attention.sdpa's contract:
+    (B, T, E) -> (B, T, E) with heads folded into the batch dim."""
+    b, t, e = q.shape
+    hd = e // num_heads
+    scale = scale or 1.0 / np.sqrt(hd)
+
+    def fold(x):
+        return x.reshape(b, t, num_heads, hd).transpose(0, 2, 1, 3) \
+            .reshape(b * num_heads, t, hd)
+
+    out = flash_attention(fold(q), fold(k), fold(v), scale=float(scale),
+                          causal=bool(causal), interpret=bool(interpret))
+    return out.reshape(b, num_heads, t, hd).transpose(0, 2, 1, 3) \
+        .reshape(b, t, e)

@@ -33,13 +33,16 @@ _OPS = {}
 
 
 class OpContext:
-    """Per-invocation execution context: train flag + PRNG key."""
+    """Per-invocation execution context: train flag + PRNG key +
+    whether the enclosing executor runs over a device mesh (ops with
+    GSPMD-opaque fast paths, e.g. pallas kernels, bail out when set)."""
 
-    __slots__ = ("is_train", "rng")
+    __slots__ = ("is_train", "rng", "mesh_active")
 
-    def __init__(self, is_train=False, rng=None):
+    def __init__(self, is_train=False, rng=None, mesh_active=False):
         self.is_train = is_train
         self.rng = rng
+        self.mesh_active = mesh_active
 
 
 def _default_arg_names(n):
