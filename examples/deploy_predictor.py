@@ -1,0 +1,66 @@
+"""Train -> checkpoint -> Predictor -> StableHLO deployment walkthrough.
+
+The inference path of docs/deployment.md as a runnable script:
+  python examples/deploy_predictor.py
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.predictor import Predictor, load_exported
+
+
+def main():
+    # 1. train a small classifier
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    y = (x[:, :8].sum(1) > x[:, 8:].sum(1)).astype(np.float32)
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net)
+    mod.fit(mx.io.NDArrayIter(x, y, batch_size=32),
+            optimizer="adam", optimizer_params={"learning_rate": 5e-3},
+            initializer=mx.initializer.Xavier(), num_epoch=5)
+
+    prefix = os.path.join(tempfile.mkdtemp(), "clf")
+    mod.save_checkpoint(prefix, 5)
+    print("checkpoint:", prefix + "-symbol.json", "+", prefix + "-0005.params")
+
+    # 2. standalone predictor from the checkpoint (no Module machinery)
+    pred = Predictor.from_checkpoint(prefix, 5, {"data": (32, 16)})
+    probs = pred.forward(data=x[:32])[0].asnumpy()
+    acc = (probs.argmax(1) == y[:32]).mean()
+    print("predictor accuracy on train head: %.2f" % acc)
+
+    # 3. internal-layer taps (MXPredCreatePartialOut analog)
+    taps = Predictor.from_checkpoint(prefix, 5, {"data": (4, 16)},
+                                     output_names=["fc1"])
+    print("fc1 activations:", taps.forward(data=x[:4])[0].shape)
+
+    # 4. StableHLO artifact: weights captured, runnable by any XLA runtime
+    blob_path = prefix + ".shlo"
+    pred.export(blob_path)
+    run = load_exported(blob_path)
+    out = np.asarray(run(x[:32])[0])
+    # the artifact may execute on a different device than the Predictor's
+    # ctx (e.g. TPU vs CPU) where matmul precision differs (bf16 vs fp32) —
+    # compare decisions plus a loose numeric tolerance
+    same_cls = (out.argmax(1) == probs.argmax(1)).all()
+    close = np.allclose(out, probs, rtol=2e-2, atol=2e-2)
+    print("stablehlo artifact: %d bytes, matches predictor: %s "
+          "(same classes: %s)"
+          % (os.path.getsize(blob_path), close, same_cls))
+
+
+if __name__ == "__main__":
+    main()

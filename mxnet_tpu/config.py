@@ -111,3 +111,11 @@ register("MXNET_PALLAS_ATTENTION", bool, False,
          "on supported shapes (self-attention, block-divisible T, head dim "
          "multiple of 64): O(T) memory instead of the einsum path's O(T^2) "
          "logits.  Falls back to einsum otherwise.")
+register("MXNET_HEARTBEAT_DIR", str, "",
+         "Shared directory for worker liveness heartbeats (failure "
+         "detection, parallel/health.py; reference ps-lite heartbeats). "
+         "Read dynamically at KVStore creation, not cached here.")
+register("MXNET_IS_RECOVERY", bool, False,
+         "Mark this worker as a restart: startup-only barriers are skipped "
+         "(reference kvstore_dist.h is_recovery).  Read dynamically at "
+         "each startup barrier, not cached here.")
