@@ -342,9 +342,21 @@ def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
     return (time.time() - start) / N
 
 
-_CONSISTENCY_TOL = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
-                    np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
-                    np.dtype(np.int32): 0}
+def _consistency_tol():
+    tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+           np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+           np.dtype(np.int32): 0}
+    try:
+        import ml_dtypes
+
+        # bf16: 7-bit mantissa (coarser than fp16's 10); 1e-1 is generous
+        tol[np.dtype(ml_dtypes.bfloat16)] = 1e-1
+    except ImportError:
+        pass
+    return tol
+
+
+_CONSISTENCY_TOL = _consistency_tol()
 
 
 def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",

@@ -1,0 +1,126 @@
+"""Cross-configuration consistency sweep — the reference's kernel oracle.
+
+test_operator_gpu.py runs every symbol on [gpu-fp64, gpu-fp32, gpu-fp16,
+cpu-fp64, cpu-fp32] and compares pairwise (test_utils.py:676-730).  The
+TPU analog (SURVEY §4): the same symbol across DTYPES (fp64 oracle vs
+fp32 vs bf16 — exercising the dtype-aware binding) and across EXECUTION
+MODES (whole-graph jit vs per-op eager, the NaiveEngine analog).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _dtype_ctx_list(shapes, dtypes=(np.float64, np.float32)):
+    out = []
+    for dt in dtypes:
+        cfg = {"ctx": mx.cpu()}
+        cfg.update(shapes)
+        cfg["type_dict"] = {name: dt for name in shapes}
+        out.append(cfg)
+    return out
+
+
+def test_fc_relu_consistency():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=8, name="fc")
+    net = sym.Activation(net, act_type="relu")
+    check_consistency(net, _dtype_ctx_list({"data": (4, 6)}))
+
+
+def test_conv_bn_pool_consistency():
+    net = sym.Convolution(sym.Variable("data"), num_filter=4,
+                          kernel=(3, 3), pad=(1, 1), name="c")
+    net = sym.BatchNorm(net, name="bn")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    check_consistency(net, _dtype_ctx_list({"data": (2, 3, 8, 8)}),
+                      tol=1e-2)
+
+
+def test_softmax_head_consistency():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=5, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    check_consistency(net, _dtype_ctx_list({"data": (6, 4),
+                                            "softmax_label": (6,)}))
+
+
+def test_attention_consistency():
+    q = sym.Variable("q")
+    net = sym.dot_product_attention(q, sym.Variable("k"), sym.Variable("v"),
+                                    num_heads=2, causal=True)
+    shapes = {n: (2, 4, 8) for n in "qkv"}
+    check_consistency(net, _dtype_ctx_list(shapes))
+
+
+def test_bf16_forward_within_tolerance():
+    """bf16 execution stays within bf16 tolerance of the fp64 oracle
+    (forward only: bf16 grads under finite precision need looser bounds)."""
+    import jax.numpy as jnp
+
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=8, name="fc")
+    net = sym.Activation(net, act_type="tanh")
+    cfgs = _dtype_ctx_list({"data": (4, 6)},
+                           dtypes=(np.float64, np.float32))
+    cfgs.append({"ctx": mx.cpu(), "data": (4, 6),
+                 "type_dict": {"data": jnp.bfloat16}})
+    check_consistency(net, cfgs, grad_req="null")
+
+
+def test_jit_vs_eager_consistency(monkeypatch):
+    """Whole-graph jit == per-op eager interpretation (the reference's
+    'compiled vs NaiveEngine' oracle) on a mixed net."""
+    from mxnet_tpu import config
+
+    net = sym.Convolution(sym.Variable("data"), num_filter=4,
+                          kernel=(3, 3), pad=(1, 1), name="c")
+    net = sym.BatchNorm(net, name="bn")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    y = rng.randint(0, 3, size=(2,)).astype(np.float32)
+
+    def run():
+        ex = net.simple_bind(mx.cpu(), data=(2, 3, 8, 8),
+                             softmax_label=(2,), grad_req="write")
+        params = {}
+        prng = np.random.RandomState(1)
+        for name, arr in ex.arg_dict.items():
+            if name in ("data", "softmax_label"):
+                continue
+            params[name] = prng.normal(0, 0.1, arr.shape).astype(np.float32)
+            arr._set_data(params[name])
+        ex.arg_dict["data"]._set_data(x)
+        ex.arg_dict["softmax_label"]._set_data(y)
+        ex.forward(is_train=True)
+        ex.backward()
+        return (ex.outputs[0].asnumpy(),
+                {n: g.asnumpy() for n, g in ex.grad_dict.items()
+                 if g is not None})
+
+    try:
+        monkeypatch.setenv("MXNET_ENGINE_TYPE", "")
+        config.refresh("MXNET_ENGINE_TYPE")
+        out_jit, grads_jit = run()
+
+        monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+        config.refresh("MXNET_ENGINE_TYPE")
+        out_eager, grads_eager = run()
+    finally:
+        # monkeypatch restores the env at teardown but cannot refresh the
+        # config cache; do both here so a failure can't leak NaiveEngine
+        monkeypatch.undo()
+        config.refresh("MXNET_ENGINE_TYPE")
+
+    np.testing.assert_allclose(out_jit, out_eager, rtol=1e-5, atol=1e-6)
+    assert set(grads_jit) == set(grads_eager)
+    for name in grads_jit:
+        np.testing.assert_allclose(grads_jit[name], grads_eager[name],
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
