@@ -149,6 +149,9 @@ class SequentialModule(BaseModule):
     def _outgoing_shapes(module, incoming):
         """Output (name, shape) pairs of a bound child, which become the
         next child's data shapes."""
+        if getattr(module, "symbol", None) is None:
+            # symbol-less children (PythonModule) declare their own
+            return [(d.name, tuple(d.shape)) for d in module.output_shapes]
         _, out_shapes, _ = module.symbol.infer_shape(
             **{name: shape for name, shape in incoming})
         return [(name, tuple(shape))
