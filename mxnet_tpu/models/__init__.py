@@ -6,7 +6,11 @@ from . import mlp
 from . import alexnet
 from . import vgg
 from . import inception_bn
+from . import inception_v3
+from . import googlenet
+from . import resnext
 from . import lstm_lm
+from . import attention_lm
 
 get_lenet = lenet.get_symbol
 get_mlp = mlp.get_symbol
@@ -14,3 +18,7 @@ get_resnet = resnet.get_symbol
 get_alexnet = alexnet.get_symbol
 get_vgg = vgg.get_symbol
 get_inception_bn = inception_bn.get_symbol
+get_inception_v3 = inception_v3.get_symbol
+get_googlenet = googlenet.get_symbol
+get_resnext = resnext.get_symbol
+get_attention_lm = attention_lm.get_symbol
