@@ -382,9 +382,20 @@ class ImageIter(DataIter):
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
-                 label_name="softmax_label", seed=None, **kwargs):
+                 label_name="softmax_label", seed=None,
+                 preprocess_threads=4, **kwargs):
         super().__init__(batch_size)
         self._rng = np.random.default_rng(seed)
+        # parallel DECODE pool (the C++ reader's preprocess_threads analog,
+        # iter_image_recordio.cc): cv2 imdecode releases the GIL so threads
+        # overlap; augmentation stays on the caller thread because the
+        # augmenters carry sequential per-pipeline RNG state
+        self._pool = None
+        if preprocess_threads and preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads,
+                                            thread_name_prefix="mxtpu-decode")
 
         # choose a source; a list/imglist overrides record labels
         self._labels = None
@@ -471,31 +482,65 @@ class ImageIter(DataIter):
                 recordio.pack(recordio.IRHeader(0, label, 0, 0), payload))
             return arr
 
+    def close(self):
+        """Release the decode thread pool (also runs at GC)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):
+        self.close()
+
+    def _collect_decoded(self, n):
+        """Up to ``n`` (label, decoded image) pairs; raw reads are
+        sequential (cheap), decodes run on the thread pool."""
+        overridden = type(self).next_sample is not ImageIter.next_sample
+        if overridden:
+            # honor the documented next_sample() extension hook: subclass
+            # overrides see every sample (sequential, no pool)
+            out = []
+            for _ in range(n):
+                try:
+                    out.append(self.next_sample())
+                except StopIteration:
+                    break
+            if not out:
+                raise StopIteration
+            return out
+        raws = []
+        for _ in range(n):
+            try:
+                raws.append(self._next_raw())
+            except StopIteration:
+                break
+        if not raws:
+            raise StopIteration
+        if self._pool is not None and len(raws) > 1:
+            decoded = list(self._pool.map(
+                lambda lp: self._decode(lp[1], lp[0]), raws))
+        else:
+            decoded = [self._decode(p, l) for l, p in raws]
+        return [(l, img) for (l, _), img in zip(raws, decoded)]
+
     # -- batching ----------------------------------------------------------
     def next(self):
         c, h, w = self.data_shape
         images = np.zeros((self.batch_size, h, w, c), np.float32)
         label_shape = self.provide_label[0].shape
         labels = np.zeros(label_shape, np.float32)
-        filled = 0
-        try:
-            while filled < self.batch_size:
-                label, img = self.next_sample()
-                if img.ndim == 2:
-                    img = np.repeat(img[:, :, None], c, axis=2)
-                for aug in self.auglist:
-                    img = aug(img)
-                if img.shape[:2] != (h, w):
-                    img = _resize(img.astype(np.float32), w, h)
-                images[filled] = img
-                labels[filled] = label
-                filled += 1
-        except StopIteration:
-            if filled == 0:
-                raise
+        samples = self._collect_decoded(self.batch_size)
+        for filled, (label, img) in enumerate(samples):
+            if img.ndim == 2:
+                img = np.repeat(img[:, :, None], c, axis=2)
+            for aug in self.auglist:
+                img = aug(img)
+            if img.shape[:2] != (h, w):
+                img = _resize(img.astype(np.float32), w, h)
+            images[filled] = img
+            labels[filled] = label
         return DataBatch([nd.array(images.transpose(0, 3, 1, 2))],
                          [nd.array(labels)],
-                         pad=self.batch_size - filled)
+                         pad=self.batch_size - len(samples))
 
 
 def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
@@ -517,6 +562,7 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
                       shuffle=shuffle, rand_crop=rand_crop,
                       rand_mirror=rand_mirror, mean=mean, std=std,
                       num_parts=num_parts, part_index=part_index, seed=seed,
+                      preprocess_threads=preprocess_threads,
                       **{k: v for k, v in kwargs.items() if k in passthrough})
     return io_mod.PrefetchingIter(inner, capacity=prefetch_buffer)
 
@@ -691,7 +737,7 @@ class ImageDetIter(ImageIter):
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name="data", label_name="label",
                  label_pad_width=None, label_pad_value=-1.0, seed=None,
-                 **kwargs):
+                 preprocess_threads=4, **kwargs):
         if aug_list is None:
             det_keys = ("resize", "rand_crop", "rand_pad", "rand_mirror",
                         "mean", "std", "min_object_covered", "area_range",
@@ -706,7 +752,8 @@ class ImageDetIter(ImageIter):
                          shuffle=shuffle, part_index=part_index,
                          num_parts=num_parts, aug_list=aug_list,
                          imglist=imglist, data_name=data_name,
-                         label_name=label_name, seed=seed)
+                         label_name=label_name, seed=seed,
+                         preprocess_threads=preprocess_threads)
         self.label_pad_value = float(label_pad_value)
         if label_pad_width is None:
             if num_parts > 1:
@@ -762,29 +809,23 @@ class ImageDetIter(ImageIter):
         images = np.zeros((self.batch_size, h, w, c), np.float32)
         labels = np.full((self.batch_size, self._max_objs, self._obj_width),
                          self.label_pad_value, np.float32)
-        filled = 0
-        try:
-            while filled < self.batch_size:
-                label, img = self.next_sample()
-                boxes, _ = self._parse_label(label)
-                if img.ndim == 2:
-                    img = np.repeat(img[:, :, None], c, axis=2)
-                for aug in self.auglist:
-                    img, boxes = aug(img, boxes)
-                if img.shape[:2] != (h, w):
-                    img = _resize(img.astype(np.float32), w, h)
-                images[filled] = img
-                n = min(len(boxes), self._max_objs)
-                if n:
-                    width = min(boxes.shape[1], self._obj_width)
-                    labels[filled, :n, :width] = boxes[:n, :width]
-                filled += 1
-        except StopIteration:
-            if filled == 0:
-                raise
+        samples = self._collect_decoded(self.batch_size)
+        for filled, (label, img) in enumerate(samples):
+            boxes, _ = self._parse_label(label)
+            if img.ndim == 2:
+                img = np.repeat(img[:, :, None], c, axis=2)
+            for aug in self.auglist:
+                img, boxes = aug(img, boxes)
+            if img.shape[:2] != (h, w):
+                img = _resize(img.astype(np.float32), w, h)
+            images[filled] = img
+            n = min(len(boxes), self._max_objs)
+            if n:
+                width = min(boxes.shape[1], self._obj_width)
+                labels[filled, :n, :width] = boxes[:n, :width]
         return DataBatch([nd.array(images.transpose(0, 3, 1, 2))],
                          [nd.array(labels)],
-                         pad=self.batch_size - filled)
+                         pad=self.batch_size - len(samples))
 
 
 def ImageDetRecordIter(path_imgrec=None, data_shape=None, batch_size=None,

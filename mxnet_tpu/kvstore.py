@@ -157,8 +157,20 @@ class KVStore:
         """Install optimizer server-side (reference pickles it to the PS,
         kvstore.py:226; here the 'server' is this process)."""
         if self._type.startswith("dist"):
-            # exercise the pickle path for parity with the reference protocol
-            optimizer = pickle.loads(pickle.dumps(optimizer))
+            # exercise the pickle path for parity with the reference
+            # protocol; a bound symbol holds op closures and cannot cross
+            # the wire — detach it around the round-trip (its derived
+            # lr/wd multiplier dicts are plain data and survive)
+            import copy as _copy
+
+            clone = _copy.copy(optimizer)     # never mutate the caller's
+            had_sym = hasattr(clone, "sym")
+            if had_sym:
+                bound_sym = clone.sym
+                clone.sym = None
+            optimizer = pickle.loads(pickle.dumps(clone))
+            if had_sym:
+                optimizer.sym = bound_sym
         from .optimizer import get_updater
 
         self._optimizer = optimizer

@@ -56,6 +56,38 @@ want = 10.0 - 0.5 * sum(r + 1 for r in range(nprocs))
 np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
 kv2.barrier()
 
+# -- distributed TRAINING to convergence (dist_lenet.py analog) ------------
+# each worker holds a disjoint shard; Module.fit(kvstore=dist_sync) must
+# reach the same accuracy single-process training would
+shard_rng = np.random.RandomState(100 + rank)
+n_shard = 128
+w_true = np.random.RandomState(7).normal(size=(6,)).astype(np.float32)
+xs = shard_rng.normal(size=(n_shard, 6)).astype(np.float32)
+ys = (xs @ w_true > 0).astype(np.float32)
+
+net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                            name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+mod = mx.mod.Module(net, context=mx.cpu())
+mx.random.seed(5)   # identical init on every worker
+it = mx.io.NDArrayIter(xs, ys, batch_size=16)
+mod.fit(it, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2},
+        initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+        kvstore="dist_sync", num_epoch=8)
+it.reset()
+acc = dict(mod.score(it, "acc"))["accuracy"]
+assert acc >= 0.9, "rank %d accuracy %.3f" % (rank, acc)
+# synchronized workers end with IDENTICAL weights: compare a checksum
+w = mod.get_params()[0]["fc1_weight"].asnumpy()
+from mxnet_tpu.parallel import collectives
+
+gathered = np.asarray(collectives.global_sum(w / nprocs))
+np.testing.assert_allclose(w, gathered, rtol=1e-5, atol=1e-6)
+
 # -- failure detection: every worker's heartbeat is fresh ------------------
 import os as _os
 

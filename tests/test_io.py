@@ -151,3 +151,31 @@ def test_image_iter_from_rec():
         batch = next(iter(it))
         assert batch.data[0].shape == (4, 3, 8, 8)
         assert batch.label[0].shape == (4,)
+
+
+def test_imageiter_threaded_decode_deterministic(tmp_path):
+    """The decode thread pool (preprocess_threads analog) yields byte-
+    identical batches to single-threaded decode."""
+    rec_path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(24):
+        img = rng.randint(0, 255, (10, 10, 3)).astype(np.uint8)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png",
+            quality=3))
+    writer.close()
+
+    def run(threads):
+        it = mx.image.ImageIter(batch_size=6, data_shape=(3, 10, 10),
+                                path_imgrec=rec_path, path_imgidx=idx_path,
+                                shuffle=True, rand_mirror=True, seed=7,
+                                preprocess_threads=threads)
+        return [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it]
+
+    single, pooled = run(1), run(4)
+    assert len(single) == len(pooled) == 4
+    for (da, la), (db, lb) in zip(single, pooled):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)
