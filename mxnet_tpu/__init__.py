@@ -55,6 +55,7 @@ from . import predictor
 from .predictor import Predictor
 from . import rnn
 from . import parallel
+from . import checkpoint
 from . import profiler
 from . import visualization
 from . import visualization as viz
