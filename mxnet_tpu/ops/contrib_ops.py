@@ -522,3 +522,31 @@ def register_all():
         doc="Inverse of quantize "
             "(ref: src/operator/contrib/dequantize.cc)."),
         aliases=("_contrib_dequantize",))
+
+    def _count_sketch(attrs, data, h, s):
+        """Count-sketch projection: out[b, h[i]] += s[i] * data[b, i].
+
+        A scatter-add over hashed indices (XLA lowers `.at[].add`
+        efficiently); differentiable in data, so the compact-bilinear-
+        pooling use case gets its backward from the executor's vjp."""
+        import jax
+        import jax.numpy as jnp
+
+        out_dim = attrs["out_dim"]
+        idx = h.reshape(-1).astype(jnp.int32)
+        signed = data * s.reshape(1, -1).astype(data.dtype)
+        return jax.vmap(
+            lambda row: jnp.zeros((out_dim,), row.dtype).at[idx].add(row)
+        )(signed)
+
+    register_op(OpDef(
+        "count_sketch", simple_compute(_count_sketch),
+        schema=ParamSchema(Param("out_dim", int, required=True),
+                           Param("processing_batch_size", int, default=32)),
+        num_inputs=3, arguments=["data", "h", "s"],
+        infer_shape=lambda a, i, x: (i, [(i[0][0], a["out_dim"])], []),
+        hint="count_sketch",
+        doc="Count-sketch random projection "
+            "(ref: src/operator/contrib/count_sketch.cc); h = hash "
+            "indices (in_dim,), s = signs (in_dim,)."),
+        aliases=("_contrib_count_sketch",))

@@ -743,6 +743,8 @@ TESTED_ELSEWHERE = {
     "dot_product_attention": "test_seq_parallel.py",
     "_contrib_DotProductAttention": "test_seq_parallel.py",
     "MoEFFN": "test_moe.py", "_contrib_MoEFFN": "test_moe.py",
+    "count_sketch": "test_spatial_contrib.py",
+    "_contrib_count_sketch": "test_spatial_contrib.py",
 }
 
 

@@ -444,3 +444,35 @@ def test_proposal_min_size_scales_with_image():
     assert not np.allclose(rois[1.0], rois[4.0])
     top = rois[4.0][0]
     assert min(top[3] - top[1] + 1, top[4] - top[2] + 1) >= 64
+
+
+def test_count_sketch():
+    """Count-sketch projection vs numpy scatter reference + backward
+    (reference: src/operator/contrib/count_sketch.cc)."""
+    import numpy as np
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    b, in_dim, out_dim = 3, 10, 6
+    x = rng.normal(size=(b, in_dim)).astype(np.float32)
+    h = rng.randint(0, out_dim, size=(in_dim,)).astype(np.float32)
+    s = rng.choice([-1.0, 1.0], size=(in_dim,)).astype(np.float32)
+
+    out = nd.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                          out_dim=out_dim).asnumpy()
+    ref = np.zeros((b, out_dim), np.float32)
+    for i in range(in_dim):
+        ref[:, int(h[i])] += s[i] * x[:, i]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(
+        out, nd._contrib_count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                      out_dim=out_dim).asnumpy())
+
+    # gradient flows to data only (h/s are fixed hash tables)
+    sgn = sym.count_sketch(sym.Variable("data"), sym.Variable("h"),
+                           sym.Variable("s"), out_dim=out_dim)
+    check_numeric_gradient(sgn, {"data": x, "h": h, "s": s},
+                           grad_nodes=["data"], rtol=0.05, atol=1e-2)
