@@ -3,6 +3,11 @@
 
 Trains on a synthetic integer-sequence corpus when no PTB file is given.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import argparse
 import logging
 

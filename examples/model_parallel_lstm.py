@@ -11,6 +11,11 @@ Run on the virtual CPU mesh for a quick check:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/model_parallel_lstm.py --num-layers 4
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import argparse
 import logging
 

@@ -5,6 +5,11 @@ Uses the idx files if present in --data-dir, else the deterministic
 synthetic dataset.  Runs on one TPU chip by default; --cpus N uses a
 virtual CPU mesh for data parallelism.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import argparse
 import logging
 
