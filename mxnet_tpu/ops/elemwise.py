@@ -155,16 +155,22 @@ def register_all():
         }
 
     for name, fn in binary_table().items():
+        # canonical arithmetic name: plus->add, minus->sub, else unchanged
+        canon = {"plus": "add", "minus": "sub"}.get(name, name)
         # elemwise form: _plus / _minus / ... (reference elemwise_binary_op.cc)
+        extra = []
+        if canon != name:
+            extra.append("_" + canon)
+        if name in ("plus", "minus", "mul", "div"):
+            # reference registers elemwise_{add,sub,mul,div} names too
+            extra.append("elemwise_" + canon)
         register_op(
             OpDef("_" + name, simple_compute(lambda attrs, a, b, f=fn: f(a, b)),
                   num_inputs=2, hint=name),
-            aliases=["_" + {"plus": "add", "minus": "sub"}.get(name, name)]
-            if name in ("plus", "minus") else [],
+            aliases=extra,
         )
         # broadcast form: broadcast_add / broadcast_plus ...
-        main = "broadcast_" + {"plus": "add", "minus": "sub", "mul": "mul",
-                               "div": "div"}.get(name, name)
+        main = "broadcast_" + canon
         ali = ["broadcast_" + name] if main != "broadcast_" + name else []
         register_op(
             OpDef(main, simple_compute(lambda attrs, a, b, f=fn: f(a, b)),

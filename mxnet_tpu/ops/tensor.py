@@ -10,6 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..attrs import Param, ParamSchema
+from ..base import MXNetError
 from ..registry import OpDef, register_op, simple_compute
 
 
@@ -156,16 +157,55 @@ def register_all():
                       schema=ParamSchema(Param("axis", int, required=True)),
                       num_inputs=1))
 
+    def _window(attrs):
+        """[begin, end) index tuple shared by slice / slice-assign ops."""
+        return tuple(slice(b, e) for b, e in zip(attrs["begin"],
+                                                 attrs["end"]))
+
     def _slice(attrs, x):
-        begin, end = attrs["begin"], attrs["end"]
-        idx = tuple(slice(b, e) for b, e in zip(begin, end))
-        return x[idx]
+        return x[_window(attrs)]
 
     register_op(OpDef("slice", simple_compute(_slice),
                       schema=ParamSchema(Param("begin", "shape", required=True),
                                          Param("end", "shape", required=True)),
                       num_inputs=1, hint="slice"),
                 aliases=["crop"])
+
+    # functional slice-assignment (reference matrix_op.cc:258,283 — the
+    # kernels behind NDArray's sliced __setitem__): returns lhs with the
+    # [begin, end) window replaced, XLA-friendly via .at[].set
+    def _slice_assign(attrs, lhs, rhs):
+        return lhs.at[_window(attrs)].set(rhs.astype(lhs.dtype))
+
+    def _slice_assign_shape(attrs, in_shapes, aux_shapes):
+        lhs = in_shapes[0]
+        if lhs is None:
+            raise MXNetError("_slice_assign cannot infer shapes without lhs")
+        window = tuple(e - b for b, e in zip(attrs["begin"], attrs["end"]))
+        return [tuple(lhs), window], [tuple(lhs)], []
+
+    register_op(OpDef(
+        "_slice_assign", simple_compute(_slice_assign),
+        schema=ParamSchema(Param("begin", "shape", required=True),
+                           Param("end", "shape", required=True)),
+        num_inputs=2, arguments=["lhs", "rhs"],
+        infer_shape=_slice_assign_shape,
+        hint="slice_assign"),
+        aliases=["_crop_assign"])
+
+    def _crop_assign_scalar(attrs, data):
+        value = jnp.asarray(attrs.get("scalar", 0.0), data.dtype)
+        return data.at[_window(attrs)].set(value)
+
+    register_op(OpDef(
+        "_crop_assign_scalar", simple_compute(_crop_assign_scalar),
+        schema=ParamSchema(Param("begin", "shape", required=True),
+                           Param("end", "shape", required=True),
+                           Param("scalar", float, default=0.0)),
+        num_inputs=1,
+        infer_shape=lambda a, i, x: (i, [i[0]], []),
+        hint="crop_assign_scalar"),
+        aliases=["_slice_assign_scalar"])
 
     def _slice_axis(attrs, x):
         axis = attrs["axis"] % x.ndim

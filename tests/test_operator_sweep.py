@@ -745,6 +745,14 @@ TESTED_ELSEWHERE = {
     "MoEFFN": "test_moe.py", "_contrib_MoEFFN": "test_moe.py",
     "count_sketch": "test_spatial_contrib.py",
     "_contrib_count_sketch": "test_spatial_contrib.py",
+    "_slice_assign": "test_reference_parity.py",
+    "_crop_assign": "test_reference_parity.py",
+    "_crop_assign_scalar": "test_reference_parity.py",
+    "_slice_assign_scalar": "test_reference_parity.py",
+    "elemwise_add": "test_reference_parity.py",
+    "elemwise_sub": "test_reference_parity.py",
+    "elemwise_mul": "test_reference_parity.py",
+    "elemwise_div": "test_reference_parity.py",
 }
 
 
