@@ -275,3 +275,56 @@ def test_bucketing_shares_one_fused_store():
     it.reset()
     score = dict(mod.score(it, metric))
     assert score["Perplexity"] < 3.0, score
+
+
+def test_bucketing_on_data_parallel_mesh():
+    """BucketingModule composes with the mesh executor: all buckets share
+    one fused store AND shard batches over the 8-device data mesh."""
+    import numpy as np
+
+    from mxnet_tpu import rnn as rnn_mod
+
+    rng = np.random.RandomState(0)
+    sentences = []
+    for _ in range(200):
+        length = rng.randint(2, 8)
+        start = rng.randint(1, 30)
+        s = [start]
+        for _ in range(length - 1):
+            s.append((s[-1] * 7 + 3) % 30 or 1)
+        sentences.append(s)
+    it = rnn_mod.BucketSentenceIter(sentences, batch_size=16, buckets=[4, 8],
+                                    seed=0)
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        emb = sym.Embedding(data, input_dim=30, output_dim=8, name="embed")
+        cell = mx.rnn.LSTMCell(16, prefix="l0_")
+        out, _ = cell.unroll(seq_len, inputs=emb, merge_outputs=True)
+        pred = sym.FullyConnected(sym.Reshape(out, shape=(-1, 16)),
+                                  num_hidden=30, name="fc")
+        return sym.SoftmaxOutput(pred, sym.Reshape(label, shape=(-1,)),
+                                 use_ignore=True, ignore_label=-1,
+                                 name="softmax"), ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=[mx.cpu(i) for i in range(8)])
+    mod.fit(it, optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(), num_epoch=7,
+            eval_metric=mx.metric.Perplexity(ignore_label=-1))
+
+    stores = {id(m._fused_step) for m in mod._buckets.values()
+              if m._fused_step is not None}
+    assert len(mod._buckets) >= 2 and len(stores) == 1
+    # batches genuinely shard over the mesh's data axis
+    group = mod._buckets[it.default_bucket_key]._exec_group
+    assert group._mesh is not None
+    spec = tuple(group.exec_.arg_dict["data"].data.sharding.spec)
+    assert spec and spec[0] == "data", spec
+
+    metric = mx.metric.Perplexity(ignore_label=-1)
+    it.reset()
+    score = dict(mod.score(it, metric))
+    assert score["Perplexity"] < 6.0, score
