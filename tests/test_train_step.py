@@ -328,3 +328,47 @@ def test_bucketing_on_data_parallel_mesh():
     it.reset()
     score = dict(mod.score(it, metric))
     assert score["Perplexity"] < 6.0, score
+
+
+def test_lr_scheduler_drives_fused_path():
+    """A FactorScheduler's decaying lr reaches the compiled step (the
+    hyper cache re-uploads when host-computed values change): fused and
+    eager trajectories match under scheduling."""
+    def build(fused):
+        import os
+
+        from mxnet_tpu import config
+
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (8, 10))],
+                 label_shapes=[("softmax_label", (8,))])
+        mx.random.seed(11)
+        mod.init_params(mx.initializer.Uniform(0.1))
+        os.environ["MXNET_FUSED_TRAIN_STEP"] = "1" if fused else "0"
+        config.refresh("MXNET_FUSED_TRAIN_STEP")
+        sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.4,
+                                             "lr_scheduler": sched})
+        os.environ["MXNET_FUSED_TRAIN_STEP"] = "1"
+        config.refresh("MXNET_FUSED_TRAIN_STEP")
+        return mod
+
+    fused, eager = build(True), build(False)
+    assert fused._fused_step is not None and eager._fused_step is None
+    for batch in _batches(8, seed=21):
+        fused.forward_backward(batch)
+        fused.update()
+        eager.forward_backward(batch)
+        eager.update()
+    # the scheduler actually decayed the lr over those updates
+    assert fused._optimizer._get_lr(0) < 0.4
+    fargs = fused.get_params()[0]
+    eargs = eager.get_params()[0]
+    for name in fargs:
+        np.testing.assert_allclose(fargs[name].asnumpy(),
+                                   eargs[name].asnumpy(), rtol=1e-5,
+                                   atol=1e-6, err_msg=name)
