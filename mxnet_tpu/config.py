@@ -110,7 +110,13 @@ register("MXNET_PALLAS_ATTENTION", bool, False,
          "Use the Pallas flash-attention kernel for dot_product_attention "
          "on supported shapes (self-attention, block-divisible T, head dim "
          "multiple of 64): O(T) memory instead of the einsum path's O(T^2) "
-         "logits.  Falls back to einsum otherwise.")
+         "logits.  Differentiable (custom_vjp backward kernels), so "
+         "training takes the flash path too.  Falls back to einsum "
+         "otherwise.")
+register("MXNET_PALLAS_INTERPRET", bool, False,
+         "Run Pallas kernels in interpret mode on non-TPU backends instead "
+         "of falling back to einsum (slow; for testing the kernel dispatch "
+         "path on CPU).")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

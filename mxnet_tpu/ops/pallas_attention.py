@@ -1,15 +1,30 @@
-"""Pallas flash-attention forward kernel (TPU).
+"""Pallas flash-attention kernels (TPU) — forward AND backward.
 
 The hot-op kernel the einsum formulation can't match at long sequence:
 ``ops.attention.sdpa`` materializes the (T, T) logits in HBM — O(T²)
-memory traffic — while this kernel streams K/V blocks through VMEM with a
-running (max, sum, acc) softmax, O(T) memory, logits never leaving the
+memory traffic — while these kernels stream K/V blocks through VMEM with
+a running (max, sum, acc) softmax, O(T) memory, logits never leaving the
 chip (flash-attention schedule; same numerics as the streaming
 accumulator in ``parallel/ring.py``, here at the kernel level).
 
+``flash_attention`` is differentiable: a ``jax.custom_vjp`` pairs the
+forward kernel (which saves a per-row logsumexp residual) with two
+backward kernels — one accumulating dQ over key blocks, one accumulating
+dK/dV over query blocks — recomputing the (T, T) probabilities blockwise
+from the residual instead of storing them.  This is the TPU analog of the
+reference's fused-kernel-that-trains precedent (its cuDNN RNN op
+implements forward *and* backward in one fused device kernel,
+``src/operator/cudnn_rnn-inl.h``): long-context *training* runs the fast
+path, not just inference.
+
+Per-row residuals (logsumexp, and delta = rowsum(dO·O)) are stored
+broadcast across a 128-lane minor dimension — ``(BH, T, LANES)`` — so the
+backward kernels consume them with the same (rows, lanes) layout the MXU
+tiles want, and no kernel ever transposes a vector.
+
 Used by ``dot_product_attention`` when ``MXNET_PALLAS_ATTENTION`` enables
 it and shapes divide the block size; anything else falls back to the
-einsum path.  ``interpret=True`` runs the same kernel on CPU for tests.
+einsum path.  ``interpret=True`` runs the same kernels on CPU for tests.
 """
 from __future__ import annotations
 
@@ -17,15 +32,46 @@ import functools
 
 import numpy as np
 
+# Block sizes swept on the bench chip (TPU v5 lite, T=2k-8k): fwd favors
+# small-Q/large-K streaming; bwd favors a fatter Q block that amortizes the
+# dQ/dK/dV accumulator read-modify-writes.
 BLOCK_Q = 128
-BLOCK_K = 128
+BLOCK_K = 512
+BLOCK_Q_BWD = 256
+BLOCK_K_BWD = 512
+LANES = 128
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-            scale, causal, block_q, block_k):
+def _pick_block(pref, t):
+    """Largest power-of-two shrink of ``pref`` that divides ``t``."""
+    b = min(pref, t)
+    while t % b:
+        b //= 2
+    return b
+
+
+def _lane_tile(x, n):
+    """(rows, LANES) residual with all lanes equal -> (rows, n)."""
+    import jax.numpy as jnp
+
+    if n == LANES:
+        return x
+    if n % LANES == 0:
+        return jnp.tile(x, (1, n // LANES))
+    return x[:, :n]
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
+            block_k, with_lse=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
 
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -86,34 +132,42 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         denom = l_scr[:, :1]
         denom = jnp.where(denom == 0.0, 1.0, denom)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        if lse_ref is not None:
+            m_fin = jnp.where(m_scr[:] == -jnp.inf, 0.0, m_scr[:])
+            d_fin = jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+            lse_ref[0] = m_fin + jnp.log(d_fin)
 
 
-def flash_attention(q, k, v, scale, causal=False, interpret=False):
-    """(BH, T, D) q/k/v -> (BH, T, D) attention output.
-
-    T must divide BLOCK_Q/BLOCK_K (the caller checks and falls back)."""
+def _fwd_call(q, k, v, scale, causal, interpret, with_lse):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q.shape
-    bq = min(BLOCK_Q, t)
-    bk = min(BLOCK_K, t)
+    bq = _pick_block(BLOCK_Q, t)
+    bk = _pick_block(BLOCK_K, t)
     grid = (bh, t // bq, t // bk)
 
     kernel = functools.partial(_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk)
-    return pl.pallas_call(
+                               block_q=bq, block_k=bk, with_lse=with_lse)
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
+    if with_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, t, LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)))
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # running max
             pltpu.VMEM((bq, 128), jnp.float32),   # running sum
@@ -121,16 +175,229 @@ def flash_attention(q, k, v, scale, causal=False, interpret=False):
         ],
         interpret=interpret,
     )(q, k, v)
+    return (res[0], res[1]) if with_lse else (res[0], None)
+
+
+def _recompute_p_ds(refs, i, j, *, scale, causal, block_q, block_k):
+    """Shared backward-recompute math: rebuild this (i, j) block's softmax
+    probabilities p and the logit cotangent ds from the forward residuals.
+    One copy keeps dQ's and dK/dV's numerics (mask convention, scale
+    application) in lockstep with each other and with the forward."""
+    import jax
+    import jax.numpy as jnp
+
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref = refs
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * jnp.float32(scale)
+    if causal:
+        qi = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kj = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qi >= kj, s, -jnp.inf)
+    lse = _lane_tile(lse_ref[0], block_k)
+    p = jnp.exp(s - lse)                        # masked lanes -> 0
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dta = _lane_tile(dta_ref[0], block_k)
+    ds = p * (dp - dta) * jnp.float32(scale)
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _update():
+        _, ds = _recompute_p_ds(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref), i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        k = k_ref[0]
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(j * block_k <= i * block_q + block_q - 1)
+        def _masked_update():
+            _update()
+    else:
+        _update()
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dk_ref,
+                    dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
+                    block_k):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)   # key block (outer)
+    i = pl.program_id(2)   # query block (inner, accumulated)
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _update():
+        p, ds = _recompute_p_ds(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref), i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        q = q_ref[0]
+        do = do_ref[0]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # query blocks strictly above this key block see none of it
+        @pl.when(i * block_q + block_q - 1 >= j * block_k)
+        def _masked_update():
+            _update()
+    else:
+        _update()
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    bq = _pick_block(BLOCK_Q_BWD, t)
+    bk = _pick_block(BLOCK_K_BWD, t)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, t, LANES))
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
+                                  causal=causal, block_q=bq, block_k=bk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, t // bq, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),       # q
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),       # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),       # v
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),       # do
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),   # lse
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),   # dta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=bq, block_k=bk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        grid=(bh, t // bk, t // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # do
+            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, 0)),   # lse
+            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, 0)),   # dta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_VJP_CACHE = {}
+
+
+def _flash_vjp():
+    """Build (once) the custom_vjp-wrapped kernel entry point."""
+    if "fn" in _VJP_CACHE:
+        return _VJP_CACHE["fn"]
+    import jax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+    def _flash(q, k, v, scale, causal, interpret):
+        out, _ = _fwd_call(q, k, v, scale, causal, interpret,
+                           with_lse=False)
+        return out
+
+    def _fwd_rule(q, k, v, scale, causal, interpret):
+        out, lse = _fwd_call(q, k, v, scale, causal, interpret,
+                             with_lse=True)
+        return out, (q, k, v, out, lse)
+
+    def _bwd_rule(scale, causal, interpret, res, do):
+        q, k, v, out, lse = res
+        return _bwd_call(q, k, v, out, lse, do, scale, causal, interpret)
+
+    _flash.defvjp(_fwd_rule, _bwd_rule)
+    _VJP_CACHE["fn"] = _flash
+    return _flash
+
+
+def flash_attention(q, k, v, scale, causal=False, interpret=False):
+    """(BH, T, D) q/k/v -> (BH, T, D) attention output.  Differentiable
+    (custom_vjp over the backward kernels — training runs the flash path).
+
+    T must divide BLOCK_Q/BLOCK_K (the caller checks and falls back)."""
+    return _flash_vjp()(q, k, v, float(scale), bool(causal),
+                        bool(interpret))
 
 
 def supported(q_shape, k_shape, causal):
-    """Whether the kernel handles these shapes (self-attention, block-
-    divisible T, lane-friendly head dim)."""
+    """Whether the kernel handles these shapes (self-attention, T a
+    multiple of the 128 sublane/lane tile, lane-friendly head dim).
+    ``_pick_block`` shrinks the preferred block sizes to divide any such
+    T, so 128-alignment is the only sequence-length constraint."""
     bh, tq, d = q_shape
     tk = k_shape[1]
     if tq != tk:                       # cross-attention: fallback
         return False
-    if tq % BLOCK_Q or tq % BLOCK_K:   # block-divisible T only
+    if tq % 128:                       # tile-aligned T only
         return False
     if d % 64 != 0:                    # lane-unfriendly heads: fallback
         return False
