@@ -41,6 +41,7 @@ def conv(p, name, x, k, s):
 
 
 BN_MODE = os.environ.get("BN", "naive")
+REMAT = os.environ.get("REMAT", "0") == "1"
 
 
 def bn_relu(p, name, x, relu=True):
@@ -48,11 +49,18 @@ def bn_relu(p, name, x, relu=True):
         return jnp.maximum(x, 0) if relu else x
     red = tuple(i for i in range(4) if i != CAXIS)
     bshape = tuple(x.shape[CAXIS] if i == CAXIS else 1 for i in range(4))
-    x32 = x.astype(jnp.float32) if BN_MODE != "bf16" else x
-    m = jnp.mean(x32, axis=red)
-    v = jnp.var(x32, axis=red)
-    if BN_MODE == "bf16":
-        m, v = m.astype(jnp.float32), v.astype(jnp.float32)
+    if BN_MODE == "onepass":
+        # sum and sumsq in one fused reduction pass (var = E[x^2]-E[x]^2)
+        x32 = x.astype(jnp.float32)
+        m = jnp.mean(x32, axis=red)
+        v = jnp.maximum(jnp.mean(jnp.square(x32), axis=red) - jnp.square(m),
+                        0.0)
+    else:
+        x32 = x.astype(jnp.float32) if BN_MODE != "bf16" else x
+        m = jnp.mean(x32, axis=red)
+        v = jnp.var(x32, axis=red)
+        if BN_MODE == "bf16":
+            m, v = m.astype(jnp.float32), v.astype(jnp.float32)
     inv = lax.rsqrt(v + 2e-5)
     scale = (inv * p[name + "_g"]).astype(x.dtype).reshape(bshape)
     shift = (p[name + "_b"] - m * inv * p[name + "_g"]).astype(x.dtype).reshape(bshape)
@@ -103,20 +111,30 @@ def forward(p, x, y):
     win = [1, 1, 3, 3] if LAYOUT == "NCHW" else [1, 3, 3, 1]
     st = [1, 1, 2, 2] if LAYOUT == "NCHW" else [1, 2, 2, 1]
     h = lax.reduce_window(h, -jnp.inf, lax.max, win, st, pads)
+    from jax.ad_checkpoint import checkpoint_name
+
+    def unit(h, nm, s, first):
+        a1 = bn_relu(p, nm + "_bn1", h)
+        c1 = checkpoint_name(conv(p, nm + "_c1", a1, 1, 1), "conv")
+        a2 = bn_relu(p, nm + "_bn2", c1)
+        c2 = checkpoint_name(conv(p, nm + "_c2", a2, 3, s), "conv")
+        a3 = bn_relu(p, nm + "_bn3", c2)
+        c3 = conv(p, nm + "_c3", a3, 1, 1)
+        sc = conv(p, nm + "_sc", a1, 1, s) if first else h
+        return c3 + sc
+
+    if REMAT:
+        unit = jax.checkpoint(
+            unit, policy=jax.checkpoint_policies.save_only_these_names("conv"),
+            static_argnums=(1, 2, 3))
+
     cin = 64
     for si, (u, f) in enumerate(zip(UNITS, FILTERS)):
         mid = f // 4
         for ui in range(u):
             nm = f"s{si}u{ui}"
             s = 2 if (ui == 0 and si > 0) else 1
-            a1 = bn_relu(p, nm + "_bn1", h)
-            c1 = conv(p, nm + "_c1", a1, 1, 1)
-            a2 = bn_relu(p, nm + "_bn2", c1)
-            c2 = conv(p, nm + "_c2", a2, 3, s)
-            a3 = bn_relu(p, nm + "_bn3", c2)
-            c3 = conv(p, nm + "_c3", a3, 1, 1)
-            sc = conv(p, nm + "_sc", a1, 1, s) if ui == 0 else h
-            h = c3 + sc
+            h = unit(h, nm, s, ui == 0)
             cin = f
     h = bn_relu(p, "bn_final", h)
     h = jnp.mean(h.astype(jnp.float32), axis=tuple(i for i in range(1, 4) if i != CAXIS))
@@ -127,12 +145,81 @@ def forward(p, x, y):
 
 
 MODE = os.environ.get("MODE", "train")
+FUSED = os.environ.get("FUSED", "0") == "1"  # pallas fused BN+ReLU+1x1conv
+
+
+def _channel_stats(x2d):
+    x32 = x2d.astype(jnp.float32)
+    return jnp.sum(x32, axis=0), jnp.sum(jnp.square(x32), axis=0)
+
+
+def _bn_coeffs(p, name, s1, s2, count):
+    mean = s1 / count
+    var = jnp.maximum(s2 / count - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + 2e-5)
+    g = p[name + "_g"]
+    return inv * g, p[name + "_b"] - mean * inv * g
+
+
+def forward_fused(p, x, y):
+    """NHWC trunk where BN statistics flow through matmul epilogues and
+    BN-apply+ReLU rides the 1x1-conv prologues (ops/pallas_fused kernels)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from mxnet_tpu.ops import pallas_fused as pf
+
+    assert LAYOUT == "NHWC" and not S2D
+    h = conv(p, "conv0", x, 7, 2)
+    h = bn_relu(p, "bn0", h)
+    h = lax.reduce_window(h, -jnp.inf, lax.max, [1, 3, 3, 1], [1, 2, 2, 1],
+                          [(0, 0), (1, 1), (1, 1), (0, 0)])
+
+    hs1, hs2 = _channel_stats(h.reshape(-1, h.shape[-1]))
+    for si, (u, f) in enumerate(zip(UNITS, FILTERS)):
+        mid = f // 4
+        for ui in range(u):
+            nm = f"s{si}u{ui}"
+            s = 2 if (ui == 0 and si > 0) else 1
+            b, hh, ww, c = h.shape
+            m = b * hh * ww
+            sc1, sh1 = _bn_coeffs(p, nm + "_bn1", hs1, hs2, m)
+            h2d = h.reshape(m, c)
+            w1 = p[nm + "_c1"].reshape(c, mid).astype(jnp.bfloat16)
+            c1, c1s1, c1s2 = pf.fused_scale_relu_matmul(h2d, sc1, sh1, w1)
+            sc2, sh2 = _bn_coeffs(p, nm + "_bn2", c1s1, c1s2, m)
+            a2 = jnp.maximum(c1.astype(jnp.float32) * sc2 + sh2, 0.0)
+            a2 = a2.astype(h.dtype).reshape(b, hh, ww, mid)
+            c2 = conv(p, nm + "_c2", a2, 3, s)
+            ho, wo = c2.shape[1], c2.shape[2]
+            m2 = b * ho * wo
+            c2d = c2.reshape(m2, mid)
+            c2s1, c2s2 = _channel_stats(c2d)
+            sc3, sh3 = _bn_coeffs(p, nm + "_bn3", c2s1, c2s2, m2)
+            if ui == 0:
+                scd = h2d if s == 1 else h[:, ::2, ::2, :].reshape(m2, c)
+                wsc = p[nm + "_sc"].reshape(c, f).astype(jnp.bfloat16)
+                res, _, _ = pf.fused_scale_relu_matmul(scd, sc1, sh1, wsc)
+            else:
+                res = h2d
+            w3 = p[nm + "_c3"].reshape(mid, f).astype(jnp.bfloat16)
+            out, hs1, hs2 = pf.fused_scale_relu_matmul(
+                c2d, sc3, sh3, w3, residual=res)
+            h = out.reshape(b, ho, wo, f)
+    scf, shf = _bn_coeffs(p, "bn_final", hs1, hs2,
+                          h.shape[0] * h.shape[1] * h.shape[2])
+    hf = jnp.maximum(h.astype(jnp.float32) * scf + shf, 0.0)
+    hv = jnp.mean(hf, axis=(1, 2))
+    logits = hv @ p["fc_w"] + p["fc_b"]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, y[:, None], axis=1)[:, 0]
+    return jnp.mean(lse - ll)
 
 
 def train(p, mom, x, y):
+    fwd = forward_fused if FUSED else forward
     if MODE == "fwd":
-        return p, mom, forward(p, x, y)
-    loss, g = jax.value_and_grad(forward)(p, x, y)
+        return p, mom, fwd(p, x, y)
+    loss, g = jax.value_and_grad(fwd)(p, x, y)
     newp, newm = {}, {}
     for k in p:
         m = 0.9 * mom[k] + g[k]

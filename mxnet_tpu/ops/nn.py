@@ -59,7 +59,11 @@ def _fc_shape(attrs, in_shapes, aux_shapes):
 
 def _conv_shape(attrs, in_shapes, aux_shapes):
     dshape = in_shapes[0]
-    n, c, h, w = dshape
+    nhwc = attrs.get("layout") == "NHWC"
+    if nhwc:
+        n, h, w, c = dshape
+    else:
+        n, c, h, w = dshape
     kh, kw = _pair(attrs["kernel"])
     sh, sw = _pair(attrs.get("stride", (1, 1)))
     ph, pw = _pair(attrs.get("pad", (0, 0)))
@@ -72,7 +76,8 @@ def _conv_shape(attrs, in_shapes, aux_shapes):
     shapes = [dshape, wshape]
     if not attrs.get("no_bias", False):
         shapes.append((nf,))
-    return shapes, [(n, nf, oh, ow)], []
+    oshape = (n, oh, ow, nf) if nhwc else (n, nf, oh, ow)
+    return shapes, [oshape], []
 
 
 def _deconv_pad(attrs, h, w):
@@ -126,7 +131,8 @@ def _bn_type(attrs, in_types, aux_types):
 
 def _bn_shape(attrs, in_shapes, aux_shapes):
     dshape = in_shapes[0]
-    c = dshape[1]
+    axis = attrs.get("axis", 1) if len(dshape) > 1 else 0
+    c = dshape[axis]
     return [dshape, (c,), (c,)], [dshape, (c,), (c,)], [(c,), (c,)]
 
 
@@ -234,15 +240,20 @@ def register_all():
         ph, pw = _pair(attrs.get("pad", (0, 0)))
         dh, dw = _pair(attrs.get("dilate", (1, 1)))
         ng = attrs.get("num_group", 1)
+        nhwc = attrs.get("layout") == "NHWC"
+        # weight stays OIHW in both layouts (checkpoint compatibility);
+        # NHWC activations avoid layout churn around the Pallas fused ops
+        dims = ("NHWC", "OIHW", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
         out = lax.conv_general_dilated(
             data, weight, window_strides=(sh, sw),
             padding=((ph, ph), (pw, pw)),
             rhs_dilation=(dh, dw),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=dims,
             feature_group_count=ng,
             preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None)
         if bias:
-            out = out + bias[0].reshape(1, -1, 1, 1)
+            bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+            out = out + bias[0].reshape(bshape)
         return out.astype(data.dtype)
 
     register_op(OpDef(
@@ -305,7 +316,9 @@ def register_all():
         Param("global_pool", bool, default=False),
         Param("pooling_convention", str, default="valid"),
         Param("stride", "shape", default=(1, 1)),
-        Param("pad", "shape", default=(0, 0)))
+        Param("pad", "shape", default=(0, 0)),
+        Param("layout", str, default=None,
+              doc="NCHW (default) or NHWC (match Convolution layout)"))
 
     def _pool_geometry(attrs, h, w):
         kh, kw = _pair(attrs["kernel"])
@@ -324,12 +337,21 @@ def register_all():
         return (kh, kw), (sh, sw), (ph, ph + eh, pw, pw + ew), (oh, ow)
 
     def _pooling(attrs, x):
-        n, c, h, w = x.shape
+        nhwc = attrs.get("layout") == "NHWC"
+        if nhwc:
+            n, h, w, c = x.shape
+        else:
+            n, c, h, w = x.shape
         (kh, kw), (sh, sw), (plo_h, phi_h, plo_w, phi_w), _ = _pool_geometry(attrs, h, w)
         ptype = attrs.get("pool_type", "max")
-        pads = ((0, 0), (0, 0), (plo_h, phi_h), (plo_w, phi_w))
-        window = (1, 1, kh, kw)
-        strides = (1, 1, sh, sw)
+        if nhwc:
+            pads = ((0, 0), (plo_h, phi_h), (plo_w, phi_w), (0, 0))
+            window = (1, kh, kw, 1)
+            strides = (1, sh, sw, 1)
+        else:
+            pads = ((0, 0), (0, 0), (plo_h, phi_h), (plo_w, phi_w))
+            window = (1, 1, kh, kw)
+            strides = (1, 1, sh, sw)
         if ptype == "max":
             init = -np.inf if jnp.issubdtype(x.dtype, jnp.floating) \
                 else np.iinfo(np.dtype(x.dtype)).min
@@ -349,7 +371,10 @@ def register_all():
         Param("momentum", float, default=0.9),
         Param("fix_gamma", bool, default=True),
         Param("use_global_stats", bool, default=False),
-        Param("output_mean_var", bool, default=False))
+        Param("output_mean_var", bool, default=False),
+        Param("axis", int, default=1,
+              doc="channel axis (1 = NCHW default; -1/3 for NHWC data, "
+                  "e.g. downstream of Convolution(layout='NHWC'))"))
 
     def _bn_train_core(eps, caxis):
         """Training-mode BN as an explicit custom_vjp.
@@ -362,12 +387,24 @@ def register_all():
         with only the channel reductions in fp32.
         """
 
-        def stats(x):
+        def stats(x, center):
+            # mean and variance in ONE fused reduction pass: jnp.var's
+            # two-pass formulation costs an extra full read of x per BN —
+            # measured 9% of the whole ResNet-50 step on the bench chip
+            # (benchmarks/ROOFLINE.md).  The shifted-data formulation
+            # var = E[(x-c)^2] - (mean-c)^2 with c = moving_mean (a
+            # constant, so the subtraction fuses into the same pass) keeps
+            # fp32 from catastrophically cancelling when |mean| >> std:
+            # the moving mean tracks the batch mean, so the summed squares
+            # stay O(var) instead of O(mean^2).
             red = tuple(i for i in range(x.ndim) if i != caxis)
-            x32 = x.astype(jnp.float32)
-            mean = jnp.mean(x32, axis=red)
-            var = jnp.var(x32, axis=red)
-            return mean, var
+            bshape = tuple(x.shape[caxis] if i == caxis else 1
+                           for i in range(x.ndim))
+            xc = x.astype(jnp.float32) - center.reshape(bshape)
+            mc = jnp.mean(xc, axis=red)
+            var = jnp.maximum(jnp.mean(jnp.square(xc), axis=red)
+                              - jnp.square(mc), 0.0)
+            return mc + center, var
 
         def apply(x, gamma, beta, mean, inv):
             bshape = tuple(x.shape[caxis] if i == caxis else 1
@@ -378,13 +415,13 @@ def register_all():
             return x * scale.reshape(bshape) + shift.reshape(bshape)
 
         @jax.custom_vjp
-        def bn(x, gamma, beta):
-            mean, var = stats(x)
+        def bn(x, gamma, beta, center):
+            mean, var = stats(x, center)
             inv = jax.lax.rsqrt(var + eps)
             return apply(x, gamma, beta, mean, inv), mean, var
 
-        def bn_fwd(x, gamma, beta):
-            mean, var = stats(x)
+        def bn_fwd(x, gamma, beta, center):
+            mean, var = stats(x, center)
             inv = jax.lax.rsqrt(var + eps)
             return (apply(x, gamma, beta, mean, inv), mean, var), \
                 (x, gamma, mean, inv)
@@ -412,7 +449,7 @@ def register_all():
             dx = dx + (dmean_ct / n).reshape(bshape) \
                 + (dvar_ct * 2.0 / n).reshape(bshape) * xmu
             return dx.astype(x.dtype), dgamma.astype(gamma.dtype), \
-                dbeta.astype(gamma.dtype)
+                dbeta.astype(gamma.dtype), jnp.zeros_like(mean)
 
         bn.defvjp(bn_fwd, bn_bwd)
         return bn
@@ -422,7 +459,9 @@ def register_all():
         moving_mean, moving_var = aux
         eps = attrs.get("eps", 1e-3)
         momentum = attrs.get("momentum", 0.9)
-        caxis = 1 if data.ndim > 1 else 0
+        caxis = attrs.get("axis", 1) if data.ndim > 1 else 0
+        if caxis < 0:
+            caxis += data.ndim
         bshape = tuple(data.shape[caxis] if i == caxis else 1 for i in range(data.ndim))
         if attrs.get("fix_gamma", True):
             gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
@@ -436,7 +475,9 @@ def register_all():
                      - mean * inv * gamma.astype(jnp.float32)).astype(data.dtype)
             out = data * scale.reshape(bshape) + shift.reshape(bshape)
         else:
-            out, mean, var = _bn_train_core(eps, caxis)(data, gamma, beta)
+            out, mean, var = _bn_train_core(eps, caxis)(
+                data, gamma, beta,
+                jax.lax.stop_gradient(moving_mean.astype(jnp.float32)))
             new_mm = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
             new_mv = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
         return [out, mean, var], [new_mm, new_mv]

@@ -1,0 +1,306 @@
+"""Fused BN-apply + ReLU + 1x1-conv Pallas kernels (TPU) — forward AND backward.
+
+The BN-ResNet traffic lever identified by ``benchmarks/ROOFLINE.md``: on a
+bandwidth-bound model, every elementwise pass over an activation tensor is
+~1 full HBM round trip.  XLA's graph runs, per BN -> ReLU -> 1x1-conv link:
+
+    stats(x): R(x) | apply: R(x), W(a) | conv: R(a), W(y) | next stats: R(y)
+
+The fused kernel here collapses the whole link into ONE pass:
+
+    y = relu(x * scale + shift) @ W (+ residual)      [matmul prologue]
+    ysum, ysumsq = per-channel sums of y              [matmul epilogue]
+
+reading x once and writing y once — scale/shift application and ReLU ride
+the MXU matmul's operand load, the *output's* BN statistics ride its result
+store, and the residual add rides the epilogue.  The next link receives
+(ysum, ysumsq) as tensors, so its BatchNorm is per-channel scalar math.
+
+Backward is one combined kernel per link (plus a small XLA prologue that
+folds the stats outputs' cotangents into an effective dy): it reads x and
+dy once and emits dx, dW, dscale, dshift together, recomputing the ReLU
+mask from x instead of storing the activation — the activation tensor `a`
+never exists in HBM in either pass.
+
+This is the TPU-shaped analog of the reference's fused-kernel perf work
+(its conv/BN go through cuDNN fused paths and hand-written epilogues —
+``docs/how_to/perf.md:107-190``); a 1x1 conv over NHWC is exactly a matmul,
+so the kernel is a tiled MXU matmul with a custom prologue/epilogue.
+
+**Measured outcome (round 4, benchmarks/ROOFLINE.md)**: on the bench chip
+the traffic saved does NOT beat XLA — its conv emitters are ~1.7× faster
+than this kernel's matmul at ResNet's shapes, so the full fused trunk runs
+0.63× the XLA step.  The op is kept as a correct, tested, opt-in fused
+kernel (`benchmarks/rn50_raw.py FUSED=1` reproduces the measurement) and as
+the worked example of the Pallas custom-kernel extension point; the
+framework's default ResNet path stays on XLA convs with one-pass BN stats.
+
+Numerics: matmul accumulates f32; y is cast to the compute dtype and the
+statistics are computed from the *cast* values, so (ysum, ysumsq) equal
+what a separate pass over the stored y would produce.
+
+``interpret=True`` runs the same kernels on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# swept on the bench chip (TPU v5 lite); see benchmarks/proto_fused.py
+BLOCK_M = 512
+BLOCK_N = 256
+BLOCK_M_BWD = 256
+
+
+def supported(m, k, n, dtype):
+    """Shapes the kernel handles without padding: all dims tile-aligned."""
+    import jax.numpy as jnp
+
+    if dtype not in (jnp.bfloat16, np.dtype("bfloat16"), jnp.float32,
+                     np.dtype("float32")):
+        return False
+    # whole-K/whole-N VMEM budget (weights + one x/dx/dy block each way,
+    # double-buffered) — stay well under the ~16MB/core budget
+    itemsize = 2 if dtype in (jnp.bfloat16, np.dtype("bfloat16")) else 4
+    if k * n * itemsize > 4 * 1024 * 1024:
+        return False
+    if m % 256 or k % 8 or n % 64:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(x_ref, scale_ref, shift_ref, w_ref, *rest, relu, has_res):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if has_res:
+        r_ref, y_ref, s1_ref, s2_ref = rest
+    else:
+        (y_ref, s1_ref, s2_ref) = rest
+        r_ref = None
+
+    i = pl.program_id(0)
+
+    a = x_ref[...].astype(jnp.float32) * scale_ref[...] + shift_ref[...]
+    if relu:
+        a = jnp.maximum(a, 0.0)
+    acc = jax.lax.dot_general(
+        a.astype(x_ref.dtype), w_ref[...],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if r_ref is not None:
+        acc = acc + r_ref[...].astype(jnp.float32)
+    y = acc.astype(y_ref.dtype)
+    y_ref[...] = y
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    y32 = y.astype(jnp.float32)  # stats of the *stored* values
+    s1_ref[...] += jnp.sum(y32, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(jnp.square(y32), axis=0, keepdims=True)
+
+
+def _fwd_call(x, scale, shift, w, residual, relu, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    m, k = x.shape
+    n = w.shape[1]
+    # 1-D grid over row blocks, whole K and N per step: x is read exactly
+    # once, the weight stays VMEM-resident (supported() bounds k*n), y is
+    # written exactly once, and the stats accumulators live in VMEM across
+    # the whole grid — minimum possible HBM traffic for this op.  Row block
+    # as large as a ~2.5MB/operand VMEM budget allows (fewer grid steps =
+    # less per-step overhead; double-buffered x and y dominate usage)
+    bm = max(256, min(8192, (2560 * 1024 // (2 * max(k, n))) // 256 * 256))
+    while m % bm:
+        bm //= 2
+    grid = (m // bm,)
+
+    in_specs = [
+        pl.BlockSpec((bm, k), lambda i: (i, 0)),
+        pl.BlockSpec((1, k), lambda i: (0, 0)),
+        pl.BlockSpec((1, k), lambda i: (0, 0)),
+        pl.BlockSpec((k, n), lambda i: (0, 0)),
+    ]
+    args = [x, scale.reshape(1, k), shift.reshape(1, k), w]
+    if residual is not None:
+        in_specs.append(pl.BlockSpec((bm, n), lambda i: (i, 0)))
+        args.append(residual)
+
+    y, s1, s2 = pl.pallas_call(
+        functools.partial(_fwd_kernel, relu=relu,
+                          has_res=residual is not None),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return y, s1[0], s2[0]
+
+
+# ---------------------------------------------------------------------------
+# backward: one combined kernel -> dx, dW, dscale, dshift
+# ---------------------------------------------------------------------------
+def _bwd_kernel(x_ref, dy_ref, scale_ref, shift_ref, w_ref,
+                dx_ref, dw_ref, dscale_ref, dshift_ref, *, relu):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        dscale_ref[...] = jnp.zeros_like(dscale_ref)
+        dshift_ref[...] = jnp.zeros_like(dshift_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    u = x * scale_ref[...] + shift_ref[...]
+    a = jnp.maximum(u, 0.0) if relu else u
+    dy = dy_ref[...]
+
+    # dW += a^T @ dy   (contraction over the row block)
+    dw_ref[...] += jax.lax.dot_general(
+        a.astype(dy.dtype), dy,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    # du = (dy @ W^T) * relu'(u)
+    dz = jax.lax.dot_general(
+        dy, w_ref[...],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    du = jnp.where(u > 0.0, dz, 0.0) if relu else dz
+
+    dx_ref[...] = (du * scale_ref[...]).astype(dx_ref.dtype)
+    dscale_ref[...] += jnp.sum(du * x, axis=0, keepdims=True)
+    dshift_ref[...] += jnp.sum(du, axis=0, keepdims=True)
+
+
+def _bwd_call(x, dy, scale, shift, w, relu, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    m, k = x.shape
+    n = w.shape[1]
+    bm = min(BLOCK_M_BWD, m)
+    while m % bm:  # same shrink rule as _fwd_call: never drop trailing rows
+        bm //= 2
+
+    dx, dw, ds, db = pl.pallas_call(
+        functools.partial(_bwd_kernel, relu=relu),
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, k), x.dtype),
+            jax.ShapeDtypeStruct((k, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, dy, scale.reshape(1, k), shift.reshape(1, k), w)
+    return dx, dw, ds[0], db[0]
+
+
+# ---------------------------------------------------------------------------
+# public op: custom_vjp (built lazily, cached per (relu, has_res, interpret))
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def _build(relu, has_res, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.custom_vjp
+    def fused(x, scale, shift, w, *res_arg):
+        return _fwd_call(x, scale, shift, w,
+                         res_arg[0] if has_res else None, relu, interpret)
+
+    def fwd(x, scale, shift, w, *res_arg):
+        out = _fwd_call(x, scale, shift, w,
+                        res_arg[0] if has_res else None, relu, interpret)
+        return out, (x, scale, shift, w, out[0])
+
+    def bwd(saved, cts):
+        x, scale, shift, w, y = saved
+        dy, dysum, dysumsq = cts
+        # fold the stats outputs' cotangents into an effective dy:
+        #   d/dy [ sum(y).dysum + sum(y^2).dysumsq ] = dysum + 2 y dysumsq
+        dy_eff = (dy.astype(jnp.float32) + dysum[None, :]
+                  + 2.0 * y.astype(jnp.float32) * dysumsq[None, :])
+        dy_eff = dy_eff.astype(x.dtype)
+        dx, dw, dscale, dshift = _bwd_call(x, dy_eff, scale, shift, w, relu,
+                                           interpret)
+        grads = (dx, dscale, dshift, dw.astype(w.dtype))
+        if has_res:
+            grads = grads + (dy_eff,)
+        return grads
+
+    fused.defvjp(fwd, bwd)
+    return fused
+
+
+def fused_scale_relu_matmul(x, scale, shift, w, residual=None, relu=True,
+                            interpret=False):
+    """y = relu(x*scale + shift) @ w (+ residual); returns (y, ysum, ysumsq).
+
+    x: (M, K); scale, shift: (K,) f32; w: (K, N); residual: (M, N) or None.
+    ysum/ysumsq are per-output-channel sums over M of the stored y — the
+    next BatchNorm's sufficient statistics, produced in the epilogue so no
+    later pass re-reads y.  Differentiable (custom_vjp); the stats outputs'
+    cotangents are folded into the backward, so BN's backward-through-
+    statistics terms arrive through ordinary autodiff composition.
+    """
+    fn = _build(bool(relu), residual is not None, bool(interpret))
+    args = (x, scale, shift, w) + ((residual,) if residual is not None else ())
+    return fn(*args)
+
+
+def reference_impl(x, scale, shift, w, residual=None, relu=True):
+    """Plain-XLA composition with identical semantics, for tests/fallback."""
+    import jax
+    import jax.numpy as jnp
+
+    a = x.astype(jnp.float32) * scale + shift
+    if relu:
+        a = jnp.maximum(a, 0.0)
+    y = jax.lax.dot_general(
+        a.astype(x.dtype), w, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    y = y.astype(x.dtype)
+    y32 = y.astype(jnp.float32)
+    return y, jnp.sum(y32, axis=0), jnp.sum(jnp.square(y32), axis=0)

@@ -155,6 +155,44 @@ def test_softmax_output_backward():
                             rtol=1e-4, atol=1e-5)
 
 
+def test_nhwc_conv_bn_pool_composition():
+    """Convolution(layout=NHWC) -> BatchNorm(axis=-1) -> Pooling(NHWC)
+    matches the NCHW composition on transposed data (same OIHW weights)."""
+    def build(layout):
+        data = sym.Variable("data")
+        if layout == "NHWC":
+            net = sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                                  pad=(1, 1), no_bias=True, layout="NHWC",
+                                  name="conv")
+            net = sym.BatchNorm(net, fix_gamma=False, axis=-1, name="bn")
+            net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max", layout="NHWC")
+        else:
+            net = sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                                  pad=(1, 1), no_bias=True, name="conv")
+            net = sym.BatchNorm(net, fix_gamma=False, name="bn")
+            net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max")
+        return net
+
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = (0.1 * rng.randn(8, 3, 3, 3)).astype(np.float32)
+    outs = {}
+    for layout in ("NCHW", "NHWC"):
+        net = build(layout)
+        xin = x if layout == "NCHW" else np.transpose(x, (0, 2, 3, 1))
+        ex = net.simple_bind(mx.cpu(), data=xin.shape)
+        ex.arg_dict["data"][:] = xin
+        ex.arg_dict["conv_weight"][:] = w
+        ex.arg_dict["bn_gamma"][:] = 1.0
+        ex.arg_dict["bn_beta"][:] = 0.0
+        assert ex.arg_dict["bn_gamma"].shape == (8,)  # channel, not height
+        outs[layout] = ex.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(outs["NCHW"],
+                        np.transpose(outs["NHWC"], (0, 3, 1, 2)),
+                        rtol=1e-4, atol=1e-5)
+
+
 def test_batchnorm_training():
     data = sym.Variable("data")
     bn = sym.BatchNorm(data, fix_gamma=False, name="bn")
@@ -173,6 +211,31 @@ def test_batchnorm_training():
     # moving stats updated
     mm = ex.aux_dict["bn_moving_mean"].asnumpy()
     assert_almost_equal(mm, 0.1 * mean.ravel(), rtol=1e-3, atol=1e-5)
+
+
+def test_batchnorm_large_mean_variance_stable():
+    """One-pass variance must not catastrophically cancel at |mean|>>std.
+
+    The shifted-data formulation centers on the moving mean; once that has
+    warmed toward the batch mean, the recovered variance is accurate even
+    when E[x^2] is ~1e6 fp32-ulps above the true variance.
+    """
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, fix_gamma=False, momentum=0.0, name="bn")
+    x = (1000.0 + 0.5 * rng.randn(8, 4, 8, 8)).astype(np.float32)
+    ex = bn.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.arg_dict["bn_beta"][:] = 0.0
+    # momentum=0: the moving mean equals the batch mean after one step,
+    # so the second forward computes stats centered on the true mean
+    ex.forward(is_train=True)
+    out = ex.forward(is_train=True)[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-3)
+    assert_almost_equal(out, expected, rtol=2e-2, atol=2e-2)
+    assert float(np.abs(out).std()) > 0.5  # not collapsed by a var=0 clamp
 
 
 def test_dropout():
