@@ -117,6 +117,14 @@ register("MXNET_PALLAS_INTERPRET", bool, False,
          "Run Pallas kernels in interpret mode on non-TPU backends instead "
          "of falling back to einsum (slow; for testing the kernel dispatch "
          "path on CPU).")
+register("MXNET_TP_MODE", str, "megatron",
+         "Tensor-parallel sharding plan over the 'model' mesh axis: "
+         "'megatron' (default) pairs column-parallel with row-parallel "
+         "weights from a graph walk (parallel/tp_rules.py) so one psum per "
+         "pair replaces per-layer all-gathers; 'naive' restores the "
+         "round-3 blanket dim-0 sharding (for A/B comparison — "
+         "tests/test_tensor_parallel.py measures the collective-count "
+         "difference from compiled HLO).")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

@@ -404,6 +404,39 @@ class Executor:
             raise MXNetError("Executor has not been run")
         return self._outputs
 
+    def compiled_hlo(self, kind="combined"):
+        """Optimized-HLO text of a cached compiled step (None when eager).
+
+        The XLA-era analog of the reference's bandwidth probe: collectives
+        are explicit ops in the compiled program, so communication per step
+        is statically countable — feed this to
+        ``parallel.hlo_stats.collective_stats``.  Avals (+shardings) are
+        rebuilt from the live buffers at call time, so nothing is retained
+        on the training hot path for this probe.
+        """
+        import jax
+
+        fn = self._fn_cache.get(kind)
+        if fn is None or not hasattr(fn, "lower"):
+            return None
+        rng = getattr(self, "_last_rng", None)
+        if rng is None:
+            return None
+
+        def _aval(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+
+        arg_vals = [_aval(self.arg_dict[n].data) for n in self._arg_names]
+        aux_vals = [_aval(self.aux_dict[n].data) for n in self._aux_names]
+        if kind == "combined":
+            old_grads = [_aval(self.grad_dict[n].data)
+                         for n in self._grad_names]
+            args = (arg_vals, aux_vals, old_grads, None, _aval(rng))
+        else:
+            args = (arg_vals, aux_vals, _aval(rng))
+        return fn.lower(*args).compile().as_text()
+
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
 

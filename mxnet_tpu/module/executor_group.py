@@ -157,6 +157,17 @@ class DataParallelExecutorGroup:
                 if axis and inode.is_variable \
                         and axis_sizes.get(axis, 1) > 1:
                     self._param_mesh_axes[inode.name] = axis
+        # Megatron column/row pairing for the 'model' axis, derived from one
+        # graph walk (parallel/tp_rules.py) — one psum per FC/Conv pair
+        # instead of the naive plan's per-layer all-gathers
+        self._tp_plan = {}
+        if self._model_par > 1:
+            from .. import config as _config
+
+            if _config.get("MXNET_TP_MODE") != "naive":
+                from ..parallel.tp_rules import plan_tensor_parallel
+
+                self._tp_plan = plan_tensor_parallel(self.symbol)
 
     def _input_sharding(self, name):
         return self._input_shardings.get(name, self._data_sharding)
@@ -165,11 +176,14 @@ class DataParallelExecutorGroup:
         """Tensor-parallel sharding rule over the 'model' mesh axis.
 
         The scaling-book recipe rather than hand-written psums: weights are
-        annotated — FullyConnected/Convolution outputs (dim 0) sharded on
-        'model', matching biases/BatchNorm params likewise — and the GSPMD
-        partitioner derives the activation shardings and inserts the
-        all-gathers/psums (Megatron-style column parallelism).  Params whose
-        leading dim doesn't divide evenly stay replicated.
+        annotated and the GSPMD partitioner derives activation shardings and
+        inserts the collectives.  Which weights, and along which dim, comes
+        from per-op graph metadata — OpDef.mesh_axes (expert stacks) first,
+        then the Megatron column/row plan (parallel/tp_rules.py) that pairs
+        FC1-column with FC2-row so one psum per pair replaces per-layer
+        all-gathers.  MXNET_TP_MODE=naive restores the round-3 blanket
+        dim-0 heuristic for A/B measurement.  Params whose sharded dim
+        doesn't divide the axis stay replicated (correctness unaffected).
         """
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -180,8 +194,18 @@ class DataParallelExecutorGroup:
                 and shape[0] % dict(self._mesh.shape)[axis] == 0:
             return NamedSharding(
                 self._mesh, P(*([axis] + [None] * (len(shape) - 1))))
-        if self._model_par <= 1 or not shape or \
-                shape[0] % self._model_par != 0:
+        if self._model_par <= 1 or not shape:
+            return self._rep_sharding
+        if self._tp_plan:
+            spec = self._tp_plan.get(name)
+            if spec is None or len(spec) != len(shape):
+                return self._rep_sharding
+            for dim, ax in enumerate(spec):
+                if ax is not None and shape[dim] % self._model_par != 0:
+                    return self._rep_sharding  # unshardable: replicate
+            return NamedSharding(self._mesh, P(*spec))
+        # naive mode: blanket dim-0 column sharding
+        if shape[0] % self._model_par != 0:
             return self._rep_sharding
         return NamedSharding(self._mesh,
                              P(*(["model"] + [None] * (len(shape) - 1))))

@@ -1,0 +1,70 @@
+"""Collective-communication accounting from compiled HLO text.
+
+The reference measures distributed communication empirically
+(``tools/bandwidth/measure.py``); under XLA the collectives are explicit in
+the optimized HLO, so the framework can *statically* count them and total
+their payload bytes.  Used by tests/test_tensor_parallel.py (asserting the
+Megatron plan emits fewer collectives than naive sharding) and
+tools/bandwidth.py (comm volume per training step).
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["collective_stats", "shape_bytes"]
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*((?:\([^)]*\))|(?:[a-z][a-z0-9]*\[[0-9,]*\]\S*))\s+"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(-start|-done)?\(")
+
+
+def shape_bytes(shape_str):
+    """Total bytes of every 'dtype[dims]' shape in the string (tuples ok)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def collective_stats(hlo_text):
+    """Count collectives and sum their result payloads.
+
+    Async start/done pairs count once (the -start carries the shape).
+    Returns {op_name: {"count": int, "bytes": int}} plus "total" entry.
+    """
+    stats = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        shape_s, op, suffix = m.group(1), m.group(2), m.group(3)
+        if suffix == "-done":
+            continue
+        if suffix == "-start":
+            # async start shapes are tuples holding operand-alias + result
+            # buffers (+ u32 context scalars); counting the whole tuple
+            # would double the payload — take the largest single buffer
+            nbytes = max((shape_bytes(s.group(0))
+                          for s in _SHAPE_RE.finditer(shape_s)), default=0)
+        else:
+            nbytes = shape_bytes(shape_s)
+        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += nbytes
+    total = {"count": sum(e["count"] for e in stats.values()),
+             "bytes": sum(e["bytes"] for e in stats.values())}
+    stats["total"] = total
+    return stats

@@ -1,0 +1,119 @@
+"""Megatron-style tensor-parallel planning over the 'model' mesh axis.
+
+The round-3 executor column-sharded *every* parameter whose leading dim
+divided the model axis — correct under GSPMD but communication-naive: the
+partitioner inserts an all-gather after every layer to re-replicate
+activations.  The Megatron pairing (column-parallel FC1, row-parallel FC2 —
+Shoeybi et al., and the scaling-book "1D weight-stationary" recipe) leaves
+the intermediate activation feature-sharded so one all-reduce per *pair*
+replaces per-layer all-gathers.
+
+This module derives that pairing from the graph rather than from user
+annotations: a single topological walk tracks whether each activation is
+feature-sharded ('feat': last/channel dim split over 'model') or replicated
+('rep'), and assigns each FullyConnected / Convolution weight a column or
+row role accordingly:
+
+    input 'rep'  -> column parallel: W[out_dim] on 'model', bias sharded,
+                    output becomes 'feat'          (no collective)
+    input 'feat' -> row parallel: W[in_dim] on 'model', bias replicated,
+                    output 'rep'                   (one psum, from GSPMD)
+
+Elementwise ops (Activation, Dropout, Cast, adds) propagate 'feat';
+BatchNorm on a 'feat' activation shards its per-channel params/aux the same
+way (its statistics reductions are per-channel, so they stay local); any
+other op conservatively resets to 'rep', which GSPMD realizes with an
+all-gather exactly where the naive plan paid one per layer.
+
+The result is a {param_name: partition-axes-tuple} plan consumed by
+DataParallelExecutorGroup._param_sharding; communication is *measured* by
+``parallel.hlo_stats`` (collective count/bytes from compiled HLO) — see
+tests/test_tensor_parallel.py and tools/bandwidth.py.
+"""
+from __future__ import annotations
+
+__all__ = ["plan_tensor_parallel", "ELEMENTWISE_OPS"]
+
+# ops through which a feature-sharded activation stays feature-sharded
+# (their compute is pointwise over the sharded dim, or reduces other dims)
+ELEMENTWISE_OPS = {
+    "Activation", "LeakyReLU", "Dropout", "Cast", "relu", "sigmoid", "tanh",
+    "exp", "log", "negative", "abs", "_plus", "_minus", "_mul", "_div",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "_plus_scalar", "_minus_scalar", "_mul_scalar", "_div_scalar",
+    "_maximum", "_minimum", "clip", "identity", "BlockGrad", "stop_gradient",
+}
+
+
+def plan_tensor_parallel(symbol):
+    """One topological walk -> {param_name: partition axes tuple}.
+
+    Axes tuples use the mesh axis name 'model' (e.g. ``('model', None)`` for
+    a column-parallel FC weight); params absent from the plan replicate.
+    Divisibility of the sharded dim is checked by the consumer at placement
+    time, per param — an unshardable member of a pair degrades to
+    replicated without breaking correctness (GSPMD re-derives).
+    """
+    plan = {}
+    state = {}  # (id(node), out_idx) -> 'rep' | 'feat'
+
+    def instate(entry):
+        return state.get((id(entry[0]), entry[1]), "rep")
+
+    for node in symbol._topo():
+        if node.is_variable:
+            state[(id(node), 0)] = "rep"
+            continue
+        attrs = node.parsed_attrs()
+        n_args = node.op.n_inputs(attrs)
+        ins = node.inputs[:n_args]
+        aux_ins = node.inputs[n_args:]
+        name = node.op.name
+        out_state = "rep"
+
+        if name == "FullyConnected":
+            data_st = instate(ins[0])
+            wnode = ins[1][0]
+            bnode = ins[2][0] if len(ins) > 2 else None
+            if wnode.is_variable:
+                if data_st == "feat":
+                    # row parallel: contract over the sharded feature dim,
+                    # GSPMD inserts the pair's single psum here
+                    plan[wnode.name] = (None, "model")
+                    out_state = "rep"
+                else:
+                    plan[wnode.name] = ("model", None)
+                    if bnode is not None and bnode.is_variable:
+                        plan[bnode.name] = ("model",)
+                    out_state = "feat"
+        elif name == "Convolution":
+            data_st = instate(ins[0])
+            wnode = ins[1][0]
+            bnode = ins[2][0] if len(ins) > 2 else None
+            if wnode.is_variable and attrs.get("num_group", 1) == 1:
+                if data_st == "feat":
+                    # row parallel over input channels (OIHW dim 1)
+                    plan[wnode.name] = (None, "model", None, None)
+                    out_state = "rep"
+                else:
+                    plan[wnode.name] = ("model", None, None, None)
+                    if bnode is not None and bnode.is_variable:
+                        plan[bnode.name] = ("model",)
+                    out_state = "feat"
+        elif name == "BatchNorm":
+            data_st = instate(ins[0])
+            if data_st == "feat":
+                for pnode, _ in ins[1:]:
+                    if pnode.is_variable:
+                        plan[pnode.name] = ("model",)
+                for anode, _ in aux_ins:
+                    plan[anode.name] = ("model",)
+                out_state = "feat"
+        elif name in ELEMENTWISE_OPS:
+            sts = [instate(e) for e in ins]
+            out_state = "feat" if sts and all(s == "feat" for s in sts) \
+                else "rep"
+
+        for i in range(node.op.n_outputs(attrs)):
+            state[(id(node), i)] = out_state
+    return plan

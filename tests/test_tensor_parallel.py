@@ -123,6 +123,75 @@ def test_tp_pure_model_axis():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_megatron_plan_pairs_column_row():
+    """tp_rules walks the graph and pairs FC1-column with FC2-row."""
+    from mxnet_tpu.parallel.tp_rules import plan_tensor_parallel
+
+    plan = plan_tensor_parallel(_mlp())
+    assert plan["fc1_weight"] == ("model", None)       # column parallel
+    assert plan["fc1_bias"] == ("model",)
+    assert plan["fc2_weight"] == (None, "model")       # row parallel
+    assert "fc2_bias" not in plan                      # added after the psum
+
+    plan = plan_tensor_parallel(_convnet())
+    assert plan["conv1_weight"] == ("model", None, None, None)
+    assert plan["bn1_gamma"] == ("model",)             # feat-sharded BN
+    assert plan["bn1_moving_mean"] == ("model",)
+    # Flatten resets the chain: fc starts a new column pair
+    assert plan["fc_weight"] == ("model", None)
+
+
+def _step_hlo(mode, monkeypatch):
+    import os
+
+    from mxnet_tpu import config as _config
+
+    monkeypatch.setenv("MXNET_TP_MODE", mode)
+    _config.refresh("MXNET_TP_MODE")
+    try:
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=64, name="fc1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)],
+                            mesh_config=MeshConfig(data=1, model=2))
+        mod.bind(data_shapes=[("data", (8, 32))],
+                 label_shapes=[("softmax_label", (8,))])
+        np.random.seed(3)  # identical params under both plans
+        mod.init_params(mx.initializer.Xavier())
+        rng = np.random.RandomState(0)
+        batch = DataBatch([nd.array(rng.randn(8, 32).astype(np.float32))],
+                          [nd.array(rng.randint(0, 4, 8).astype(np.float32))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        out = mod.get_outputs()[0].asnumpy()
+        hlo = mod._exec_group.exec_.compiled_hlo()
+    finally:
+        _config.refresh("MXNET_TP_MODE")
+    return hlo, out
+
+
+def test_megatron_fewer_collectives_than_naive(monkeypatch):
+    """The round-4 contract: the pairing measurably cuts communication.
+
+    Counted from optimized HLO (parallel/hlo_stats), not asserted from
+    intuition: a 2-layer MLP train step at model=2 must move fewer
+    collectives (and fewer bytes) under the megatron plan than under
+    blanket dim-0 sharding.
+    """
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
+
+    hlo_m, out_m = _step_hlo("megatron", monkeypatch)
+    hlo_n, out_n = _step_hlo("naive", monkeypatch)
+    np.testing.assert_allclose(out_m, out_n, rtol=1e-5, atol=1e-6)
+
+    st_m = collective_stats(hlo_m)
+    st_n = collective_stats(hlo_n)
+    assert st_m["total"]["count"] < st_n["total"]["count"], (st_m, st_n)
+    assert st_m["total"]["bytes"] < st_n["total"]["bytes"], (st_m, st_n)
+
+
 def test_tp_survives_reshape():
     """Module.reshape keeps the mesh_config (model axis intact)."""
     net = _mlp()

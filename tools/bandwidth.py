@@ -30,12 +30,70 @@ def _time(fn, *args, iters=5):
     return (time.perf_counter() - t0) / iters
 
 
+def model_step_report(n_model):
+    """Static comm accounting for one tensor-parallel training step.
+
+    Compiles a 2-layer-MLP train step at model=n_model under both TP plans
+    (megatron pairing vs naive dim-0) and prints collective count + payload
+    bytes from the optimized HLO — the XLA-era version of the reference's
+    per-batch push/pull cost table.
+    """
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _config
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.parallel import MeshConfig
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
+
+    def step_stats(mode):
+        os.environ["MXNET_TP_MODE"] = mode
+        _config.refresh("MXNET_TP_MODE")
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=256, name="fc1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=256, name="fc2")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(n_model)],
+                            mesh_config=MeshConfig(data=1, model=n_model))
+        mod.bind(data_shapes=[("data", (16, 64))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params(mx.initializer.Xavier())
+        rng = np.random.RandomState(0)
+        batch = DataBatch([nd.array(rng.randn(16, 64).astype(np.float32))],
+                          [nd.array(rng.randint(0, 4, 16).astype(np.float32))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        hlo = mod._exec_group.exec_.compiled_hlo()
+        if hlo is None:
+            raise SystemExit("step ran eagerly (MXNET_ENGINE_TYPE=NaiveEngine"
+                             " or group2ctx placement?) — no compiled HLO to"
+                             " account; unset the eager knobs and retry")
+        return collective_stats(hlo)
+
+    for mode in ("megatron", "naive"):
+        st = step_stats(mode)
+        print("TP plan %-9s: %3d collectives, %8.1f KiB/step moved" %
+              (mode, st["total"]["count"], st["total"]["bytes"] / 1024),
+              flush=True)
+        for op, e in sorted(st.items()):
+            if op != "total":
+                print("    %-19s x%-3d %8.1f KiB" %
+                      (op, e["count"], e["bytes"] / 1024), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh (0 = real)")
     ap.add_argument("--sizes", default="1,16,64,256",
                     help="payload sizes in MiB")
+    ap.add_argument("--model-step", type=int, default=0, metavar="N",
+                    help="also report per-step collective count/bytes of a "
+                         "2-layer MLP at tensor-parallel degree N "
+                         "(megatron vs naive plan)")
     args = ap.parse_args()
 
     import jax
@@ -86,6 +144,9 @@ def main():
 
         print("%8.1f MiB | h2d %7.2f GB/s | all-reduce %7.2f GB/s | "
               "all-gather %7.2f GB/s" % (mb, h2d, ar, ag), flush=True)
+
+    if args.model_step:
+        model_step_report(args.model_step)
 
 
 if __name__ == "__main__":
