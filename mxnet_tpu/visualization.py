@@ -9,80 +9,84 @@ from . import symbol as sym_mod
 __all__ = ["print_summary", "plot_network"]
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Tabular summary with param counts (reference: visualization.py:20)."""
-    if not isinstance(symbol, sym_mod.Symbol):
-        raise TypeError("symbol must be Symbol")
-    show_shape = False
+def _summary_rows(symbol, shape):
+    """Collect one record per compute node: (label, out_shape, nparams, preds).
+
+    Pure data gathering — rendering is a separate concern (`_render_table`).
+    """
     shape_dict = {}
     if shape is not None:
-        show_shape = True
-        arg_names = symbol.list_arguments()
         arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
-        shape_dict = dict(zip(arg_names, arg_shapes))
-        shape_dict.update(dict(zip(symbol.list_auxiliary_states(), aux_shapes)))
+        shape_dict.update(zip(symbol.list_arguments(), arg_shapes))
+        shape_dict.update(zip(symbol.list_auxiliary_states(), aux_shapes))
         internals = symbol.get_internals()
         _, out_shapes, _ = internals.infer_shape(**shape)
-        shape_dict.update(dict(zip(internals.list_outputs(), out_shapes)))
+        shape_dict.update(zip(internals.list_outputs(), out_shapes))
 
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
-    if positions[-1] <= 1:
-        positions = [int(line_length * p) for p in positions]
-    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
-
-    def print_row(fields, positions):
-        line = ""
-        for i, field in enumerate(fields):
-            line += str(field)
-            line = line[:positions[i]]
-            line += " " * (positions[i] - len(line))
-        print(line)
-
-    print("_" * line_length)
-    print_row(to_display, positions)
-    print("=" * line_length)
-    total_params = [0]
-
-    def print_layer_summary(node, out_shape):
-        op = node["op"]
-        pre_node = []
-        pre_filter = 0
-        if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-        cur_param = 0
-        for inp in node.get("inputs", []):
-            input_node = nodes[inp[0]]
-            if input_node["op"] == "null" and not input_node.get("is_aux"):
-                pshape = shape_dict.get(input_node["name"])
-                if pshape and not input_node["name"].endswith(("data", "label")):
-                    n = 1
-                    for s in pshape:
-                        n *= s
-                    cur_param += n
-        first_connection = pre_node[0] if pre_node else ""
-        fields = ["%s(%s)" % (node["name"], op), out_shape, cur_param,
-                  first_connection]
-        print_row(fields, positions)
-        for i in range(1, len(pre_node)):
-            fields = ["", "", "", pre_node[i]]
-            print_row(fields, positions)
-        total_params[0] += cur_param
-
-    heads = set(h[0] for h in conf["heads"])
-    for node in nodes:
+    head_ids = {h[0] for h in conf["heads"]}
+    rows = []
+    for nid, node in enumerate(nodes):
         if node["op"] == "null":
             continue
-        out_shape = shape_dict.get(node["name"] + "_output", "") if show_shape else ""
-        print_layer_summary(node, out_shape)
-        print("_" * line_length)
-    print("Total params: %s" % total_params[0])
-    print("_" * line_length)
+        nparams = 0
+        preds = []
+        for src_id, *_ in node.get("inputs", []):
+            src = nodes[src_id]
+            if src["op"] != "null" or src_id in head_ids:
+                preds.append(src["name"])
+            elif not src.get("is_aux"):
+                pshape = shape_dict.get(src["name"])
+                if pshape and not src["name"].endswith(("data", "label")):
+                    count = 1
+                    for dim in pshape:
+                        count *= dim
+                    nparams += count
+        out_shape = ""
+        if shape is not None:
+            out_shape = shape_dict.get(node["name"] + "_output", "")
+        rows.append(("%s(%s)" % (node["name"], node["op"]),
+                     out_shape, nparams, preds))
+    return rows
+
+
+def _render_table(rows, line_length, positions):
+    """Format gathered records into the fixed-column summary table."""
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    widths = [positions[0]] + [b - a for a, b in zip(positions, positions[1:])]
+
+    def fmt(cells):
+        return "".join(str(c)[:w].ljust(w) for c, w in zip(cells, widths))
+
+    lines = ["_" * line_length,
+             fmt(["Layer (type)", "Output Shape", "Param #", "Previous Layer"]),
+             "=" * line_length]
+    for label, out_shape, nparams, preds in rows:
+        lines.append(fmt([label, out_shape, nparams,
+                          preds[0] if preds else ""]))
+        lines.extend(fmt(["", "", "", p]) for p in preds[1:])
+        lines.append("_" * line_length)
+    total = sum(r[2] for r in rows)
+    lines.append("Total params: %s" % total)
+    lines.append("_" * line_length)
+    return lines
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Tabular per-layer summary with param counts.
+
+    Capability parity with the reference's summary printer
+    (python/mxnet/visualization.py:20) — same columns, separators, and
+    total-params footer — built as gather-records-then-render rather than
+    an incremental truncation printer.
+    """
+    if not isinstance(symbol, sym_mod.Symbol):
+        raise TypeError("symbol must be Symbol")
+    for line in _render_table(_summary_rows(symbol, shape),
+                              line_length, list(positions)):
+        print(line)
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,

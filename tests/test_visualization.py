@@ -1,0 +1,51 @@
+"""print_summary tests (reference capability: python/mxnet/visualization.py)."""
+import pytest
+
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import visualization as viz
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=5, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_print_summary_counts_params(capsys):
+    viz.print_summary(_mlp(), shape={"data": (8, 20)})
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    assert lines[1].startswith("Layer (type)")
+    assert "Output Shape" in lines[1] and "Param #" in lines[1]
+    # fc1: 20*10+10 = 210, fc2: 10*5+5 = 55 -> 265 total
+    assert "Total params: 265" in out
+    fc1_row = next(l for l in lines if l.startswith("fc1(FullyConnected)"))
+    assert "210" in fc1_row and "(8, 10)" in fc1_row
+    fc2_row = next(l for l in lines if l.startswith("fc2(FullyConnected)"))
+    assert "relu1" in fc2_row  # previous-layer column
+
+
+def test_print_summary_multi_input_rows(capsys):
+    a = sym.Variable("data")
+    b = sym.FullyConnected(a, num_hidden=4, name="fca")
+    c = sym.FullyConnected(a, num_hidden=4, name="fcb")
+    net = b + c
+    viz.print_summary(net, shape={"data": (2, 4)})
+    out = capsys.readouterr().out
+    # the add node lists both predecessors, the second on its own row
+    add_idx = next(i for i, l in enumerate(out.splitlines()) if "fca" in l
+                   and "elemwise" in l.lower() or "_plus" in l)
+    assert any("fcb" in l for l in out.splitlines()[add_idx:add_idx + 2])
+
+
+def test_print_summary_rejects_non_symbol():
+    with pytest.raises(TypeError):
+        viz.print_summary("not a symbol")
+
+
+def test_print_summary_no_shape(capsys):
+    viz.print_summary(_mlp())
+    out = capsys.readouterr().out
+    assert "Total params: 0" in out  # no shapes -> no param counts
