@@ -4,8 +4,10 @@ from .module import Module
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule, PythonLossModule
+from .pipeline_module import PipelineModule
 from .executor_group import DataParallelExecutorGroup
 
 __all__ = ["BaseModule", "BatchEndParam", "Module", "BucketingModule",
            "SequentialModule", "PythonModule", "PythonLossModule",
+           "PipelineModule",
            "DataParallelExecutorGroup"]

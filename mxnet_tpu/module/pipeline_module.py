@@ -1,0 +1,523 @@
+"""PipelineModule — GPipe pipeline parallelism through the Module API.
+
+The reference reaches model parallelism through a user-facing API
+(``group2ctx`` stage annotations driven from ``Module``,
+``example/model-parallel-lstm/lstm.py:48-112``); round 3 left the TPU
+pipeline engine (``parallel/pipeline.py``) as a library function reachable
+only from raw ``shard_map``.  This module closes that gap: the user
+describes a pipeline with Symbols and trains it with the ordinary
+``Module.fit`` workflow (bind / init_params / init_optimizer / fit /
+score), while the module compiles ONE donated XLA program per step that
+runs embed -> GPipe fill-drain schedule over the 'pipe' mesh axis ->
+head, with the optimizer update fused in (the reference's
+update-per-batch, as one program).
+
+Pipeline model (the standard homogeneous-stage primitive):
+
+* ``stage_symbol`` — ONE stage's computation, input variable ``data``,
+  single output of the same shape (e.g. an LSTM/transformer block).  The
+  module stacks its parameters ``num_stages`` times with a leading stage
+  axis sharded on 'pipe' — each device owns one stage's weights, stage s
+  applies slice s.
+* ``embed_symbol`` (optional) — maps the raw batch to the stage
+  activation shape (e.g. Embedding); runs data-parallel before the pipe.
+* ``head_symbol`` — consumes the pipeline output (input ``data``) plus
+  label variables and ends in a loss op (e.g. SoftmaxOutput); runs
+  data-parallel after the pipe.
+
+The batch (axis 0) is split into ``num_microbatches`` microbatches that
+flow through stages via ``lax.ppermute``; devices along the mesh's 'data'
+axis additionally shard every microbatch (data parallelism composes).
+Backward needs no schedule of its own: the fill-drain scan is
+differentiable, so ``jax.vjp`` of the whole step yields the reverse
+pipeline (parallel/pipeline.py).
+
+Constraints (raised at bind): symbols must be free of auxiliary state
+(use LayerNorm-style ops, not BatchNorm, inside stages) and the optimizer
+must provide a fused kernel (all first-party ones do).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..registry import OpContext
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from .base_module import BaseModule
+from ..io import DataDesc
+
+__all__ = ["PipelineModule"]
+
+
+def _symbol_fn(symbol):
+    """Compile a Symbol into a pure function {name: jnp} -> [outputs].
+
+    A trimmed executor walk (no aux, no placement): PipelineModule symbols
+    are stateless by contract, so the graph is a pure function suitable
+    for use inside shard_map/scan.
+    """
+    if symbol.list_auxiliary_states():
+        raise MXNetError(
+            "PipelineModule symbols must not carry auxiliary state "
+            "(BatchNorm moving stats etc.); use stateless normalization "
+            "inside pipeline stages")
+    nodes = list(symbol._topo())
+    outputs = symbol._outputs
+
+    def fn(env, is_train, rng=None):
+        import jax
+
+        values = {}
+        for seq, node in enumerate(nodes):
+            if node.is_variable:
+                values[(id(node), 0)] = env[node.name]
+                continue
+            attrs = node.parsed_attrs()
+            n_args = node.op.n_inputs(attrs)
+            ins = [values[(id(s), i)] for s, i in node.inputs[:n_args]]
+            node_rng = jax.random.fold_in(rng, seq) if rng is not None \
+                else None
+            octx = OpContext(is_train=is_train, rng=node_rng,
+                             mesh_active=True)
+            outs, _ = node.op.fcompute(attrs, ins, [], octx)
+            for i, o in enumerate(outs):
+                values[(id(node), i)] = o
+        return [values[(id(n), i)] for n, i in outputs]
+
+    return fn
+
+
+class PipelineModule(BaseModule):
+    def __init__(self, stage_symbol, head_symbol, num_stages,
+                 num_microbatches, embed_symbol=None, context=None,
+                 logger=logging):
+        super().__init__(logger=logger)
+        self._stage_sym = stage_symbol
+        self._head_sym = head_symbol
+        self._embed_sym = embed_symbol
+        self._num_stages = int(num_stages)
+        self._num_micro = int(num_microbatches)
+        if context is None:
+            context = [Context("cpu", i) for i in range(num_stages)]
+        if not isinstance(context, (list, tuple)):
+            context = [context]
+        self._context = [c if isinstance(c, Context) else Context(c)
+                         for c in context]
+        if len(self._context) % self._num_stages:
+            raise MXNetError("need a multiple of num_stages devices "
+                             "(%d given for %d stages)"
+                             % (len(self._context), self._num_stages))
+        self._data_par = len(self._context) // self._num_stages
+
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self._outputs = None
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return ["data"]
+
+    @property
+    def output_names(self):
+        return self._head_sym.list_outputs()
+
+    @property
+    def symbol(self):
+        return self._head_sym
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = [d if isinstance(d, DataDesc) else
+                             DataDesc(d[0], d[1]) for d in data_shapes]
+        self._label_shapes = [d if isinstance(d, DataDesc) else
+                              DataDesc(d[0], d[1])
+                              for d in (label_shapes or [])]
+        batch = self._data_shapes[0].shape[0]
+        if batch % self._num_micro:
+            raise MXNetError("batch %d not divisible by num_microbatches %d"
+                             % (batch, self._num_micro))
+        mb = batch // self._num_micro
+        if mb % self._data_par:
+            raise MXNetError("microbatch %d not divisible by data-parallel "
+                             "degree %d" % (mb, self._data_par))
+        self._batch = batch
+        self._mb = mb
+
+        # shape inference through the three sections
+        in_shape = self._data_shapes[0].shape
+        if self._embed_sym is not None:
+            eargs, eout, _ = self._embed_sym.infer_shape(
+                data=(mb,) + in_shape[1:])
+            act_shape = eout[0]
+            self._embed_shapes = dict(zip(self._embed_sym.list_arguments(),
+                                          eargs))
+            self._embed_shapes.pop("data")
+        else:
+            act_shape = (mb,) + in_shape[1:]
+            self._embed_shapes = {}
+        sargs, souts, _ = self._stage_sym.infer_shape(data=act_shape)
+        if tuple(souts[0]) != tuple(act_shape):
+            raise MXNetError("stage must preserve the activation shape "
+                             "(got %s from %s)" % (souts[0], act_shape))
+        self._act_shape = tuple(act_shape)
+        self._stage_shapes = dict(zip(self._stage_sym.list_arguments(),
+                                      sargs))
+        self._stage_shapes.pop("data")
+
+        head_kwargs = {"data": (batch,) + tuple(act_shape[1:])}
+        for d in self._label_shapes:
+            head_kwargs[d.name] = d.shape
+        hargs, houts, _ = self._head_sym.infer_shape(**head_kwargs)
+        self._head_shapes = dict(zip(self._head_sym.list_arguments(), hargs))
+        self._head_shapes.pop("data")
+        head_args = set(self._head_sym.list_arguments())
+        self._label_names = [d.name for d in self._label_shapes
+                             if d.name in head_args]
+        self._label_shape_map = {d.name: tuple(d.shape)
+                                 for d in self._label_shapes}
+        # any head variable that is neither data nor a parameter we size
+        # (e.g. an auto-created loss label) gets zeros when no label is fed
+        for n in self._label_names:
+            self._head_shapes.pop(n, None)
+        self._extra_head_vars = {
+            n: tuple(s) for n, s in zip(self._head_sym.list_arguments(),
+                                        hargs)
+            if n not in self._head_shapes and n != "data"}
+        self._output_shapes = list(zip(self._head_sym.list_outputs(),
+                                       [tuple(s) for s in houts]))
+
+        # mesh: (pipe, data)
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devices = [c.jax_device for c in self._context]
+        if len(set(devices)) != len(devices):
+            raise MXNetError("PipelineModule needs distinct devices (use "
+                             "the 8-virtual-CPU test mesh or real chips)")
+        self._mesh = Mesh(np.array(devices).reshape(
+            self._num_stages, self._data_par), ("pipe", "data"))
+        self._stage_sharding = {
+            n: NamedSharding(self._mesh, P(*(("pipe",) + (None,) * len(s))))
+            for n, s in self._stage_shapes.items()}
+        self._rep_sharding = NamedSharding(self._mesh, P())
+        self._x_sharding = NamedSharding(
+            self._mesh, P("data", *([None] * (len(in_shape) - 1))))
+
+        self._stage_fn = _symbol_fn(self._stage_sym)
+        self._head_fn = _symbol_fn(self._head_sym)
+        self._embed_fn = (_symbol_fn(self._embed_sym)
+                          if self._embed_sym is not None else None)
+        self.for_training = for_training
+        self._step = None
+        self._fwd_fns = {}
+        self._hyper_cache = None
+        self.binded = True
+
+    # ------------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        from ..initializer import InitDesc, Uniform
+
+        initializer = initializer or Uniform(0.01)
+        import jax
+
+        def make(name, shape):
+            arr = nd.zeros(shape)
+            initializer(InitDesc(name), arr)
+            return np.asarray(arr.asnumpy())
+
+        params = {}
+        for name, shape in self._stage_shapes.items():
+            if arg_params and name in arg_params:
+                stacked = arg_params[name].asnumpy()
+            else:
+                stacked = np.stack([make(name, shape)
+                                    for _ in range(self._num_stages)])
+            params[name] = jax.device_put(stacked.astype(np.float32),
+                                          self._stage_sharding[name])
+        for shapes in (self._embed_shapes, self._head_shapes):
+            for name, shape in shapes.items():
+                if arg_params and name in arg_params:
+                    host = arg_params[name].asnumpy()
+                else:
+                    host = make(name, shape)
+                params[name] = jax.device_put(host.astype(np.float32),
+                                              self._rep_sharding)
+        self._params = params
+        self.params_initialized = True
+
+    def get_params(self):
+        return ({n: nd.array(np.asarray(v)) for n, v in self._params.items()},
+                {})
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        import jax
+
+        for n, v in (arg_params or {}).items():
+            if n not in self._params:
+                if not allow_extra:
+                    raise MXNetError("unknown param %r" % n)
+                continue
+            sh = (self._stage_sharding[n] if n in self._stage_shapes
+                  else self._rep_sharding)
+            self._params[n] = jax.device_put(
+                v.asnumpy().astype(np.float32), sh)
+        self.params_initialized = True
+
+    # ------------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params)
+            optimizer_params.setdefault("rescale_grad", 1.0 / self._batch)
+            idx2name = {i: n for i, n in enumerate(sorted(self._params))}
+            optimizer = opt_mod.create(optimizer, param_idx2name=idx2name,
+                                       **optimizer_params)
+        self._optimizer = optimizer
+        kernel = optimizer.fused_kernel()
+        if kernel is None:
+            raise MXNetError("PipelineModule needs an optimizer with a "
+                             "fused kernel (got %s)"
+                             % type(optimizer).__name__)
+        self._make_slots, self._opt_apply = kernel
+        self._param_order = sorted(self._params)
+        self._slots = {n: self._make_slots(self._params[n])
+                       for n in self._param_order}
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.pipeline import pipeline_apply
+
+        mesh = self._mesh
+        m, mb = self._num_micro, self._mb
+        act_tail = self._act_shape[1:]
+        stage_names = sorted(self._stage_shapes)
+        stage_fn, head_fn, embed_fn = \
+            self._stage_fn, self._head_fn, self._embed_fn
+        label_names = self._label_names
+        opt_apply = self._opt_apply
+        order = self._param_order
+
+        stage_specs = {n: P(*(("pipe",) + (None,) * len(s)))
+                       for n, s in self._stage_shapes.items()}
+
+        def pipe(sp, a, rng):
+            def body(p, xx, key):
+                # distinct stochastic-op keys per stage (fold on the stage
+                # index); microbatches of one stage share a mask — the
+                # GPipe scan reuses one stage trace for all of them
+                skey = jax.random.fold_in(
+                    key, jax.lax.axis_index("pipe"))
+
+                def run_stage(pdict, act):
+                    env = dict(pdict)
+                    env["data"] = act
+                    return stage_fn(env, True, skey)[0]
+
+                return pipeline_apply(run_stage, p, xx, "pipe", m)
+
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(stage_specs, P(None, "data"), P()),
+                out_specs=P(None, "data"))(sp, a, rng)
+
+        def fwd(params, x, labels, rng):
+            a = x
+            if embed_fn is not None:
+                env = {n: params[n] for n in self._embed_shapes}
+                # embed runs per microbatch shape (mb, ...): flatten batch
+                env["data"] = a.reshape((m * mb,) + a.shape[1:])
+                a = embed_fn(env, True, rng)[0]
+            a = jnp.reshape(a, (m, mb) + act_tail)
+            sp = {n: params[n] for n in stage_names}
+            piped = pipe(sp, a, rng)
+            h = jnp.reshape(piped, (m * mb,) + act_tail)
+            env = {n: params[n] for n in self._head_shapes}
+            env["data"] = h
+            for nme, shape in self._extra_head_vars.items():
+                env[nme] = jnp.zeros(shape, jnp.float32)
+            env.update(labels)
+            return head_fn(env, True, rng)
+
+        def step(params, slots, x, labels, lrs, wds, rescale, clip, extra,
+                 rng):
+            outs, vjp_fn = jax.vjp(
+                lambda p: fwd(p, x, labels, rng), params)
+            cts = [jnp.ones_like(o) for o in outs]
+            (grads,) = vjp_fn(cts)
+            new_params = dict(params)
+            new_slots = {}
+            for i, nme in enumerate(order):
+                g = grads[nme].astype(params[nme].dtype)
+                w, s = opt_apply(params[nme], g, slots[nme], lrs[i], wds[i],
+                                 rescale, clip, extra)
+                new_params[nme] = w.astype(params[nme].dtype)
+                new_slots[nme] = tuple(
+                    sn.astype(so.dtype) for sn, so in zip(s, slots[nme]))
+            return new_params, new_slots, outs
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_fwd_only(self, is_train):
+        """Forward-only program (no grads, no update) for forward()/score."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from ..parallel.pipeline import pipeline_apply
+
+        m, mb = self._num_micro, self._mb
+        act_tail = self._act_shape[1:]
+        stage_names = sorted(self._stage_shapes)
+        stage_fn, head_fn, embed_fn = \
+            self._stage_fn, self._head_fn, self._embed_fn
+
+        stage_specs = {n: P(*(("pipe",) + (None,) * len(s)))
+                       for n, s in self._stage_shapes.items()}
+
+        def eval_fn(params, x, rng):
+            a = x
+            if embed_fn is not None:
+                env = {n: params[n] for n in self._embed_shapes}
+                env["data"] = a
+                a = embed_fn(env, is_train, rng)[0]
+            a = jnp.reshape(a, (m, mb) + act_tail)
+            sp = {n: params[n] for n in stage_names}
+
+            def body(p, xx, key):
+                skey = jax.random.fold_in(
+                    key, jax.lax.axis_index("pipe"))
+
+                def run_stage(pdict, act):
+                    env = dict(pdict)
+                    env["data"] = act
+                    return stage_fn(env, is_train, skey)[0]
+
+                return pipeline_apply(run_stage, p, xx, "pipe", m)
+
+            piped = shard_map(
+                body, mesh=self._mesh,
+                in_specs=(stage_specs, P(None, "data"), P()),
+                out_specs=P(None, "data"))(sp, a, rng)
+            h = jnp.reshape(piped, (m * mb,) + act_tail)
+            env = {n: params[n] for n in self._head_shapes}
+            env["data"] = h
+            for nme, shape in self._extra_head_vars.items():
+                env[nme] = jnp.zeros(shape, jnp.float32)
+            return head_fn(env, is_train, rng)
+
+        return jax.jit(eval_fn)
+
+    # ------------------------------------------------------------------
+    def forward_backward(self, data_batch):
+        """One fused train step (forward + reverse pipeline + update)."""
+        import jax
+
+        from .. import random as _rnd
+
+        if self._step is None:
+            self._step = self._build_step()
+        x = jax.device_put(data_batch.data[0].data, self._x_sharding)
+        labels = {}
+        for nme, arr in zip([d.name for d in self._label_shapes],
+                            data_batch.label or []):
+            if nme in self._label_names:
+                labels[nme] = jax.device_put(arr.data, self._rep_sharding)
+        idx = list(range(len(self._param_order)))
+        lrs, wds, rescale, clip = self._optimizer.fused_hyper(idx)
+        extra = self._optimizer.fused_extra()
+        # keep hypers device-resident across steps (one transfer total with
+        # a constant schedule — same policy as train_step.py's fused step)
+        cached = self._hyper_cache
+        if cached is not None and np.array_equal(cached[0], lrs) \
+                and np.array_equal(cached[1], wds) \
+                and cached[2] == rescale and cached[3] == clip \
+                and np.array_equal(cached[4], extra):
+            lrs, wds, rescale, clip, extra = cached[5]
+        else:
+            import jax.numpy as jnp
+
+            dev = (jnp.asarray(lrs), jnp.asarray(wds), rescale, clip,
+                   jnp.asarray(extra))
+            self._hyper_cache = (lrs, wds, rescale, clip, extra, dev)
+            lrs, wds, rescale, clip, extra = dev
+        self._params, self._slots, outs = self._step(
+            self._params, self._slots, x, labels, lrs, wds, rescale, clip,
+            extra, _rnd.split_key())
+        self._outputs = outs
+
+    def update(self):
+        pass  # the optimizer update is fused into the step program
+
+    def backward(self, out_grads=None):
+        raise MXNetError("PipelineModule fuses forward/backward/update; "
+                         "use forward_backward()")
+
+    def forward(self, data_batch, is_train=None):
+        """Forward only — never updates parameters (Module contract;
+        training steps go through forward_backward)."""
+        import jax
+
+        from .. import random as _rnd
+
+        if is_train is None:
+            is_train = self.for_training
+        if self._fwd_fns.get(bool(is_train)) is None:
+            self._fwd_fns[bool(is_train)] = self._build_fwd_only(
+                bool(is_train))
+        x = jax.device_put(data_batch.data[0].data, self._x_sharding)
+        self._outputs = self._fwd_fns[bool(is_train)](
+            self._params, x, _rnd.split_key())
+
+    def get_outputs(self, merge_multi_context=True):
+        return [nd.NDArray(o, self._context[0]) for o in self._outputs]
+
+    def get_input_grads(self, merge_multi_context=True):
+        raise MXNetError("inputs_need_grad is not supported by "
+                         "PipelineModule")
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        raise MXNetError("per-op monitoring is not available inside the "
+                         "pipelined program; use NaiveEngine on a "
+                         "non-pipelined Module to inspect values")

@@ -103,6 +103,131 @@ def test_pipeline_grads_match_sequential():
                             np.asarray(g_seq[i][1]), rtol=1e-4, atol=1e-5)
 
 
+def _stage_sym(d):
+    from mxnet_tpu import symbol as sym
+
+    s = sym.FullyConnected(sym.Variable("data"), num_hidden=d, name="fc")
+    return sym.Activation(s, act_type="tanh", name="act")
+
+
+def _head_sym(classes):
+    from mxnet_tpu import symbol as sym
+
+    h = sym.FullyConnected(sym.Variable("data"), num_hidden=classes,
+                           name="out")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_pipeline_module_matches_unrolled_module():
+    """PipelineModule forward == a single-device Module running the same
+    stages unrolled, given identical parameters."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.io import DataBatch
+
+    d, classes, n_stages, batch = 8, 3, 4, 8
+    rng = np.random.RandomState(0)
+
+    # unrolled single-device reference
+    net = sym.Variable("data")
+    for s in range(n_stages):
+        net = sym.FullyConnected(net, num_hidden=d, name="fc%d" % s)
+        net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=classes, name="out")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    ref = mx.mod.Module(net, context=mx.cpu(0))
+    ref.bind(data_shapes=[("data", (batch, d))],
+             label_shapes=[("softmax_label", (batch,))])
+    ref.init_params(mx.initializer.Xavier())
+    arg_params, _ = ref.get_params()
+
+    pipe = mx.mod.PipelineModule(
+        _stage_sym(d), _head_sym(classes), num_stages=n_stages,
+        num_microbatches=4, context=[mx.cpu(i) for i in range(8)])
+    pipe.bind(data_shapes=[("data", (batch, d))],
+              label_shapes=[("softmax_label", (batch,))])
+    stacked_w = nd.array(np.stack(
+        [arg_params["fc%d_weight" % s].asnumpy() for s in range(n_stages)]))
+    stacked_b = nd.array(np.stack(
+        [arg_params["fc%d_bias" % s].asnumpy() for s in range(n_stages)]))
+    pipe.init_params(arg_params={"fc_weight": stacked_w,
+                                 "fc_bias": stacked_b,
+                                 "out_weight": arg_params["out_weight"],
+                                 "out_bias": arg_params["out_bias"]})
+
+    X = rng.randn(batch, d).astype(np.float32)
+    batch_data = DataBatch([nd.array(X)], [])
+    ref.forward(batch_data, is_train=False)
+    pipe.forward(batch_data, is_train=False)
+    assert_almost_equal(ref.get_outputs()[0].asnumpy(),
+                        pipe.get_outputs()[0].asnumpy(),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_module_fit_converges():
+    """Module.fit drives the pipelined train step (pipe=4 x data=2) to fit
+    a separable toy problem."""
+    from mxnet_tpu.io import NDArrayIter
+
+    d, classes, n_stages = 8, 2, 4
+    rng = np.random.RandomState(3)
+    n = 64
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    pipe = mx.mod.PipelineModule(
+        _stage_sym(d), _head_sym(classes), num_stages=n_stages,
+        num_microbatches=4, context=[mx.cpu(i) for i in range(8)])
+    it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=16)
+    np.random.seed(7)  # Xavier draws from global np.random; pin the init
+    pipe.fit(it, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+             initializer=mx.initializer.Xavier(), num_epoch=30,
+             eval_metric="acc")
+    it.reset()
+    score = dict(pipe.score(it, "acc"))
+    assert score["accuracy"] > 0.9, score
+
+
+def test_pipeline_module_dropout_stage_trains():
+    """Stochastic ops inside stages get a per-stage rng (regression: rng
+    was not threaded into the pipelined stage walk)."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.io import NDArrayIter
+
+    d, classes = 8, 2
+    s = sym.FullyConnected(sym.Variable("data"), num_hidden=d, name="fc")
+    s = sym.Activation(s, act_type="tanh")
+    s = sym.Dropout(s, p=0.2, name="drop")
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, d).astype(np.float32)
+    y = rng.randint(0, classes, 32).astype(np.float32)
+    pipe = mx.mod.PipelineModule(
+        s, _head_sym(classes), num_stages=4, num_microbatches=4,
+        context=[mx.cpu(i) for i in range(8)])
+    it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=16)
+    pipe.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+             initializer=mx.initializer.Xavier(), num_epoch=1)
+    # forward(is_train=False) must not update params
+    p0 = {n: v.asnumpy() for n, v in pipe.get_params()[0].items()}
+    it.reset()
+    pipe.score(it, "acc")
+    p1 = {n: v.asnumpy() for n, v in pipe.get_params()[0].items()}
+    for n in p0:
+        np.testing.assert_array_equal(p0[n], p1[n])
+
+
+def test_pipeline_module_rejects_stateful_stage():
+    from mxnet_tpu import symbol as sym
+
+    s = sym.BatchNorm(sym.Variable("data"), name="bn")
+    with pytest.raises(mx.base.MXNetError):
+        mx.mod.PipelineModule(s, _head_sym(2), num_stages=4,
+                              num_microbatches=2,
+                              context=[mx.cpu(i) for i in range(4)]) \
+            .bind(data_shapes=[("data", (8, 4))])
+
+
 def test_pipeline_composes_with_data_axis():
     """(pipe=4, data=2) mesh: pipeline over stages, batch sharded on data."""
     import jax

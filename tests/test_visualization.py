@@ -35,8 +35,9 @@ def test_print_summary_multi_input_rows(capsys):
     viz.print_summary(net, shape={"data": (2, 4)})
     out = capsys.readouterr().out
     # the add node lists both predecessors, the second on its own row
-    add_idx = next(i for i, l in enumerate(out.splitlines()) if "fca" in l
-                   and "elemwise" in l.lower() or "_plus" in l)
+    add_idx = next(i for i, l in enumerate(out.splitlines())
+                   if "fca" in l and ("elemwise" in l.lower()
+                                      or "_plus" in l))
     assert any("fcb" in l for l in out.splitlines()[add_idx:add_idx + 2])
 
 
