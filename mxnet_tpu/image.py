@@ -424,7 +424,14 @@ class ImageIter(DataIter):
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
-        if num_parts > 1 and self._order is not None:
+        if num_parts > 1:
+            if self._order is None:
+                # silently iterating the full set would duplicate every
+                # sample across workers — fail loudly instead (sequential
+                # record files can't be sharded; supply path_imgidx)
+                raise MXNetError(
+                    "num_parts > 1 needs a keyed source to shard "
+                    "(path_imgidx for record files, or an image list)")
             span = len(self._order) // num_parts
             self._order = self._order[part_index * span:
                                       (part_index + 1) * span]

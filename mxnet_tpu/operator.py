@@ -13,6 +13,16 @@ TPU-native execution: the user's Python runs on the host through
 stalling the device — and a ``jax.custom_vjp`` pairs the user's backward
 with XLA's autodiff, so Custom nodes compose with jit/vjp exactly like
 built-in ops.
+
+**Purity contract (deviation from the reference).**  The reference's
+Custom is an effectful engine op; under XLA, ``pure_callback``'s contract
+lets the runtime elide the call when outputs are unused, cache it across
+identical invocations, and re-execute it (e.g. under remat).  CustomOp
+``forward``/``backward`` must therefore be *pure functions of their
+inputs*: no counters, no internal state carried across calls, no side
+effects the program depends on.  Ops that need mutable state belong in
+:class:`~mxnet_tpu.module.PythonModule` (host-side module computation),
+which runs outside jit.
 """
 from __future__ import annotations
 
@@ -32,7 +42,10 @@ _SYSTEM_KEYS = ("op_type", "ctx_group")
 class CustomOp:
     """Base for user ops.  Subclasses implement ``forward`` and (when the
     op participates in training) ``backward``; both receive NDArray lists
-    and write results with :meth:`assign`."""
+    and write results with :meth:`assign`.
+
+    Both methods MUST be pure functions of their inputs (see the module
+    docstring): the XLA runtime may skip, cache, or replay them."""
 
     def forward(self, is_train, req, in_data, out_data, aux):
         raise NotImplementedError
@@ -125,8 +138,11 @@ def _custom_fcompute(attrs, inputs, aux, octx):
 
     prop = get_prop(attrs)
     if prop.list_auxiliary_states():
-        raise MXNetError("Custom aux states are not supported on the "
-                         "jit path; keep state inside the CustomOp")
+        raise MXNetError(
+            "Custom aux states are not supported on the jit path; Custom "
+            "forward/backward must be pure functions of their inputs "
+            "(pure_callback may elide/cache/replay them) — stateful "
+            "computation belongs in PythonModule")
     in_shapes = [tuple(v.shape) for v in inputs]
     _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
     in_types = [np.dtype(v.dtype) for v in inputs]

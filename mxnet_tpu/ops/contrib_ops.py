@@ -115,6 +115,15 @@ def _corner_to_center(boxes):
                      axis=-1)
 
 
+def _rank_desc(scores):
+    """Each element's 0-based rank when sorting descending (rank < k
+    selects the top-k) — the shared top-k-by-score primitive for mining
+    and pre-NMS cuts."""
+    import jax.numpy as jnp
+
+    return jnp.argsort(jnp.argsort(-scores))
+
+
 # ---------------------------------------------------------------------------
 # MultiBox* (SSD)
 # ---------------------------------------------------------------------------
@@ -176,9 +185,12 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
 
     iou_thresh = attrs["overlap_threshold"]
     variances = attrs["variances"]
+    mining_ratio = attrs.get("negative_mining_ratio", -1.0)
+    mining_thresh = attrs.get("negative_mining_thresh", 0.5)
+    ignore_label = attrs.get("ignore_label", -1.0)
     anc = anchors[0]                                    # (A, 4)
 
-    def one(lab):
+    def one(lab, cls_pred):
         valid = lab[:, 0] >= 0                          # (O,)
         iou = _iou_matrix(anc, lab[:, 1:5])             # (A, O)
         iou = jnp.where(valid[None, :], iou, -1.0)
@@ -192,7 +204,27 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
         matched = forced | (best_iou >= iou_thresh)
 
         gt = lab[best_o]                                # (A, 5)
-        cls_t = jnp.where(matched, gt[:, 0] + 1.0, 0.0)
+        if mining_ratio > 0:
+            # hard-negative mining (ref multibox_target.cc:162-221): only
+            # unmatched anchors with IoU below negative_mining_thresh are
+            # candidates; the hardest (lowest background probability from
+            # cls_pred (classes, A)) num_positive*ratio become background,
+            # every other unmatched anchor gets ignore_label
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.minimum(
+                (num_pos * mining_ratio).astype(jnp.int32),
+                anc.shape[0] - num_pos)
+            logits = cls_pred.astype(jnp.float32)       # (C, A)
+            bg_prob = jax.nn.softmax(logits, axis=0)[0]  # (A,)
+            cand = (~matched) & (best_iou < mining_thresh)
+            hardness = jnp.where(cand, -bg_prob, -jnp.inf)
+            rank = _rank_desc(hardness)
+            neg = cand & (rank < num_neg)
+            cls_t = jnp.where(
+                matched, gt[:, 0] + 1.0,
+                jnp.where(neg, 0.0, ignore_label))
+        else:
+            cls_t = jnp.where(matched, gt[:, 0] + 1.0, 0.0)
 
         a_c = _corner_to_center(anc)
         g_c = _corner_to_center(gt[:, 1:5])
@@ -208,7 +240,7 @@ def _multibox_target(attrs, anchors, labels, cls_preds):
         return (loc * mask).reshape(-1), \
             jnp.broadcast_to(mask, loc.shape).reshape(-1), cls_t
 
-    loc_t, loc_m, cls_t = jax.vmap(one)(labels)
+    loc_t, loc_m, cls_t = jax.vmap(one)(labels, cls_preds)
     return loc_t, loc_m, cls_t
 
 
@@ -273,6 +305,7 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchors):
     nms_thresh = attrs["nms_threshold"]
     variances = attrs["variances"]
     force_suppress = attrs["force_suppress"]
+    nms_topk = attrs.get("nms_topk", -1)
     anc_c = _corner_to_center(anchors[0])
 
     def one(probs, loc):
@@ -281,6 +314,11 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchors):
         cls_id = jnp.argmax(fg, axis=0)                 # (A,)
         score = jnp.max(fg, axis=0)
         keep_score = score > thresh
+        if nms_topk > 0:
+            # only the top-k candidates by score enter NMS
+            # (ref multibox_detection.cc:125-127)
+            rank = _rank_desc(jnp.where(keep_score, score, -jnp.inf))
+            keep_score = keep_score & (rank < nms_topk)
         order, keep_nms = _greedy_nms(
             boxes, jnp.where(keep_score, score, 0.0), nms_thresh,
             class_ids=None if force_suppress else cls_id)
@@ -311,6 +349,7 @@ def _proposal(attrs, cls_prob, bbox_pred, im_info):
     scales = attrs["scales"]
     ratios = attrs["ratios"]
     stride = attrs["feature_stride"]
+    pre_top = attrs["rpn_pre_nms_top_n"]
     post_top = attrs["rpn_post_nms_top_n"]
     nms_thresh = attrs["threshold"]
     min_size = attrs["rpn_min_size"]
@@ -351,10 +390,21 @@ def _proposal(attrs, cls_prob, bbox_pred, im_info):
           ((boxes[:, 3] - boxes[:, 1] + 1) >= scaled_min)
     scores = jnp.where(big, scores, 0.0)
 
+    # pre-NMS cut: only the rpn_pre_nms_top_n highest-scoring candidates
+    # enter NMS (ref proposal.cc:295-296)
+    if pre_top > 0:
+        pre_rank = _rank_desc(jnp.where(scores > 0, scores, -jnp.inf))
+        scores = jnp.where(pre_rank < pre_top, scores, 0.0)
+
     order, keep = _greedy_nms(boxes, scores, nms_thresh)
-    # rank kept boxes first, then take the static top-n
-    rank = jnp.argsort(~keep, stable=True)
-    top = order[rank][:post_top]
+    # survivors in score order; short outputs cycle the kept boxes, the
+    # reference's padding rule (proposal.cc: keep[i % out_size]) so
+    # downstream ROI consumers never see uninitialized rows
+    valid = keep & (scores[order] > 0)
+    rank = jnp.argsort(~valid, stable=True)
+    nkept = jnp.maximum(jnp.sum(valid), 1)
+    pos = jnp.arange(post_top) % nkept
+    top = order[rank][pos]
     out = jnp.concatenate([jnp.zeros((post_top, 1), boxes.dtype),
                            boxes[top]], axis=1)
     return out
@@ -445,6 +495,7 @@ def register_all():
             Param("overlap_threshold", float, default=0.5),
             Param("ignore_label", float, default=-1.0),
             Param("negative_mining_ratio", float, default=-1.0),
+            Param("negative_mining_thresh", float, default=0.5),
             Param("variances", "float_tuple", default=(0.1, 0.1, 0.2, 0.2))),
         num_inputs=3, num_outputs=3,
         arguments=["anchor", "label", "cls_pred"],

@@ -93,14 +93,37 @@ class MXRecordIO:
             if self._lib.rio_writer_write(self.handle, data, len(data)) < 0:
                 raise MXNetError(native_error(self._lib))
             return
-        # single-record encoding (cflag=0); large records are not split
         if len(data) > 0x1FFFFFFF:
             raise MXNetError("record too large (max 2^29-1 bytes per frame)")
-        self.handle.write(struct.pack("<II", _kMagic, len(data)))
-        self.handle.write(data)
-        pad = (4 - len(data) % 4) % 4
-        if pad:
-            self.handle.write(b"\x00" * pad)
+
+        def part(cflag, payload):
+            self.handle.write(struct.pack(
+                "<II", _kMagic, (cflag << 29) | len(payload)))
+            self.handle.write(payload)
+            pad = (4 - len(payload) % 4) % 4
+            if pad:
+                self.handle.write(b"\x00" * pad)
+
+        # dmlc framing: payloads embedding the magic at 4B-aligned offsets
+        # split there, the magic bytes replaced by the next part's header
+        # (so chunked magic-scanning readers always hit real boundaries)
+        magic_bytes = struct.pack("<I", _kMagic)
+        splits = []
+        pos = data.find(magic_bytes)
+        while pos != -1:
+            if pos % 4 == 0:
+                splits.append(pos)
+                pos = data.find(magic_bytes, pos + 4)
+            else:
+                pos = data.find(magic_bytes, pos + 1)
+        if not splits:
+            part(0, data)
+            return
+        begin = 0
+        for k, pos in enumerate(splits):
+            part(1 if k == 0 else 2, data[begin:pos])
+            begin = pos + 4
+        part(3, data[begin:])
 
     def read(self):
         assert not self.writable
@@ -146,6 +169,8 @@ class MXRecordIO:
                 if cflag not in (2, 3):
                     raise MXNetError("corrupt split-record chain in %s"
                                      % self.uri)
+                # restore the magic the writer dropped at the split point
+                record.extend(struct.pack("<I", _kMagic))
                 record.extend(data)
                 if cflag == 3:
                     return bytes(record)

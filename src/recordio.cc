@@ -6,10 +6,14 @@
 // interoperate both ways):
 //   record  := [kMagic:u32le][(cflag<<29)|len:u32le][data:len][pad to 4B]
 //   cflag   := 0 whole record | 1 first part | 2 middle part | 3 last part
-// Split records (cflag 1/2/3) arise when data contains the magic; the
-// reference's writer splits at embedded-magic positions. This reader
-// reassembles them; this writer emits whole records (and escapes nothing:
-// parity with python/recordio.py's single-record writer).
+// Split records (cflag 1/2/3) arise when data contains the magic at a
+// 4-byte-aligned position: the writer splits there and DROPS the embedded
+// magic bytes (the next part's header magic stands in for them), so
+// magic-scanning chunk readers always land on real frame boundaries.  The
+// reader re-inserts the magic between parts while reassembling.  Both
+// directions match the reference's dmlc writer/reader, so .rec files
+// interoperate both ways — including with its partitioned
+// RecordIOChunkReader.
 //
 // Exposed as a C ABI consumed by mxnet_tpu/_native.py over ctypes.
 
@@ -98,28 +102,61 @@ int64_t rio_writer_tell(void *h) {
   return static_cast<int64_t>(std::ftell(static_cast<Writer *>(h)->fp));
 }
 
-// Returns the record's start offset (for indexing), or -1 on error.
-int64_t rio_writer_write(void *h, const void *data, uint64_t len) {
-  Writer *w = static_cast<Writer *>(h);
-  if (len > kLenMask) {
-    set_error("record too large (max 2^29-1 bytes per frame)");
-    return -1;
-  }
-  int64_t start = std::ftell(w->fp);
-  uint32_t header[2] = {kMagic, static_cast<uint32_t>(len) & kLenMask};
-  if (std::fwrite(header, 1, sizeof(header), w->fp) != sizeof(header) ||
-      (len && std::fwrite(data, 1, len, w->fp) != len)) {
+namespace {
+
+// One framed part: [magic][(cflag<<29)|len][data][pad]. Returns 0 ok, -1 err.
+int write_part(FILE *fp, uint32_t cflag, const uint8_t *data, uint32_t len) {
+  uint32_t header[2] = {kMagic, (cflag << 29) | (len & kLenMask)};
+  if (std::fwrite(header, 1, sizeof(header), fp) != sizeof(header) ||
+      (len && std::fwrite(data, 1, len, fp) != len)) {
     set_error("short write");
     return -1;
   }
   uint32_t pad = (4u - (len & 3u)) & 3u;
   if (pad) {
     const uint8_t zeros[4] = {0, 0, 0, 0};
-    if (std::fwrite(zeros, 1, pad, w->fp) != pad) {
+    if (std::fwrite(zeros, 1, pad, fp) != pad) {
       set_error("short write (pad)");
       return -1;
     }
   }
+  return 0;
+}
+
+}  // namespace
+
+// Returns the record's start offset (for indexing), or -1 on error.
+// Payloads embedding the magic at aligned positions are split there, the
+// magic bytes replaced by the following part's header (dmlc framing).
+int64_t rio_writer_write(void *h, const void *data, uint64_t len) {
+  Writer *w = static_cast<Writer *>(h);
+  if (len > kLenMask) {
+    set_error("record too large (max 2^29-1 bytes per frame)");
+    return -1;
+  }
+  const uint8_t *bytes = static_cast<const uint8_t *>(data);
+  int64_t start = std::ftell(w->fp);
+
+  std::vector<uint64_t> magics;
+  for (uint64_t i = 0; i + 4 <= len; i += 4) {
+    if (std::memcmp(bytes + i, &kMagic, 4) == 0) magics.push_back(i);
+  }
+  if (magics.empty()) {
+    if (write_part(w->fp, 0, bytes, static_cast<uint32_t>(len)) != 0)
+      return -1;
+    return start;
+  }
+  uint64_t begin = 0;
+  for (size_t k = 0; k < magics.size(); ++k) {
+    uint32_t cflag = (k == 0) ? 1u : 2u;
+    if (write_part(w->fp, cflag, bytes + begin,
+                   static_cast<uint32_t>(magics[k] - begin)) != 0)
+      return -1;
+    begin = magics[k] + 4;  // the dropped magic: restored by the reader
+  }
+  if (write_part(w->fp, 3, bytes + begin,
+                 static_cast<uint32_t>(len - begin)) != 0)
+    return -1;
   return start;
 }
 
@@ -162,6 +199,10 @@ int rio_reader_next(void *h, const void **data, uint64_t *len) {
   if (rc <= 0) return rc;
   if (cflag == 1) {  // split record: keep consuming until the closing part
     for (;;) {
+      // the writer dropped the embedded magic at each split point; the
+      // continuation's header magic stands in for it — restore it here
+      const uint8_t *m = reinterpret_cast<const uint8_t *>(&kMagic);
+      r->buf.insert(r->buf.end(), m, m + 4);
       rc = read_chunk(r->fp, &r->buf, &cflag);
       if (rc <= 0) {
         set_error("unterminated split record");

@@ -153,6 +153,22 @@ def test_image_iter_from_rec():
         assert batch.label[0].shape == (4,)
 
 
+def test_imageiter_num_parts_needs_keyed_source(tmp_path):
+    """num_parts > 1 on a sequential (non-indexed) record file must raise:
+    silently iterating the whole set would duplicate samples per worker."""
+    rec_path = str(tmp_path / "p.rec")
+    writer = recordio.MXRecordIO(rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (8, 8, 3)).astype(np.uint8)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    writer.close()
+    with pytest.raises(mx.base.MXNetError, match="num_parts"):
+        mx.image.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                           path_imgrec=rec_path, num_parts=2, part_index=0)
+
+
 def test_imageiter_threaded_decode_deterministic(tmp_path):
     """The decode thread pool (preprocess_threads analog) yields byte-
     identical batches to single-threaded decode."""

@@ -108,9 +108,11 @@ def test_native_split_record_reassembly(tmp_path):
         frame(3, part3)
         frame(0, b"after")
 
+    magic = struct.pack("<I", _kMagic)
     r = recordio.MXRecordIO(path, "r")
     assert r.read() == b"before"
-    assert r.read() == part1 + part2 + part3
+    # dmlc semantics: the split-point magics the writer dropped are restored
+    assert r.read() == part1 + magic + part2 + magic + part3
     assert r.read() == b"after"
     assert r.read() is None
     r.close()
@@ -132,10 +134,38 @@ def test_python_codec_split_reassembly_and_limits(tmp_path):
         frame(3, part2)
         frame(0, b"plain")
     r = PyIO(path, "r")
-    assert r.read() == part1 + part2
+    assert r.read() == part1 + struct.pack("<I", _kMagic) + part2
     assert r.read() == b"plain"
     assert r.read() is None
     r.close()
+
+
+@pytest.mark.parametrize("codec", ["native", "python"])
+def test_magic_embedding_payload_roundtrip(tmp_path, codec):
+    """Payloads containing the magic at aligned offsets round-trip exactly:
+    the writer splits there (so chunk readers can scan by magic) and the
+    reader restores the dropped bytes — both codecs, cross-read."""
+    magic = struct.pack("<I", _kMagic)
+    payloads = [
+        magic,                                  # nothing but a magic
+        b"abcd" + magic + b"efgh",              # aligned embed
+        magic + magic + b"tail",                # consecutive magics
+        b"xy" + magic,                          # UNaligned embed: no split
+        os.urandom(64) + magic + os.urandom(32),
+    ]
+    path = str(tmp_path / ("m_%s.rec" % codec))
+    cls = recordio.MXRecordIO if codec == "native" else _python_codec_io()
+    w = cls(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    # read back with BOTH codecs: framing must interoperate
+    for rcls in (recordio.MXRecordIO, _python_codec_io()):
+        r = rcls(path, "r")
+        for p in payloads:
+            assert r.read() == p
+        assert r.read() is None
+        r.close()
 
 
 def test_native_indexed_seek(tmp_path):

@@ -263,6 +263,75 @@ def test_multibox_target_and_detection_roundtrip():
         assert max(ious) > 0.5
 
 
+def test_multibox_target_hard_negative_mining():
+    """negative_mining_ratio keeps only the hardest num_pos*ratio negatives
+    as background; every other unmatched anchor gets ignore_label
+    (ref multibox_target.cc:162-221)."""
+    anchors = nd.MultiBoxPrior(nd.zeros((1, 3, 4, 4)), sizes=(0.4,))
+    gt = np.array([[[0, 0.1, 0.1, 0.4, 0.4],
+                    [1, 0.6, 0.6, 0.9, 0.9],
+                    [-1, 0, 0, 0, 0]]], np.float32)
+    n_anchor = anchors.shape[1]
+    # confident-background predictions except a few "hard" anchors
+    preds = np.zeros((1, 3, n_anchor), np.float32)
+    preds[0, 0, :] = 4.0              # background logit high everywhere
+    hard = [3, 7, 11]
+    preds[0, 0, hard] = -4.0          # hard negatives: background unlikely
+    _, _, cls_t = nd.MultiBoxTarget(
+        anchors, nd.array(gt), nd.array(preds),
+        negative_mining_ratio=1.0, ignore_label=-1.0)
+    cls_np = cls_t.asnumpy()[0]
+    num_pos = int((cls_np > 0).sum())
+    assert num_pos >= 2
+    negatives = np.nonzero(cls_np == 0)[0]
+    ignored = np.nonzero(cls_np == -1)[0]
+    # ratio 1.0: as many mined negatives as positives, rest ignored
+    assert len(negatives) == num_pos
+    assert len(ignored) == n_anchor - num_pos - len(negatives)
+    # the mined negatives are the hardest (lowest background prob) anchors
+    for a in negatives:
+        assert preds[0, 0, a] < 0 or a in hard
+    # without mining: every unmatched anchor is background, none ignored
+    _, _, cls_all = nd.MultiBoxTarget(anchors, nd.array(gt), nd.array(preds))
+    assert (cls_all.asnumpy() >= 0).all()
+
+
+def test_multibox_detection_nms_topk():
+    """nms_topk caps the candidates entering NMS: at most k survivors."""
+    anchors = nd.MultiBoxPrior(nd.zeros((1, 3, 8, 8)), sizes=(0.2,))
+    n_anchor = anchors.shape[1]
+    probs = np.zeros((1, 2, n_anchor), np.float32)
+    probs[0, 1] = np.linspace(0.3, 0.9, n_anchor)
+    loc = np.zeros((1, n_anchor * 4), np.float32)
+    det_all = nd.MultiBoxDetection(nd.array(probs), nd.array(loc), anchors,
+                                   nms_threshold=0.99).asnumpy()
+    det_k = nd.MultiBoxDetection(nd.array(probs), nd.array(loc), anchors,
+                                 nms_threshold=0.99, nms_topk=5).asnumpy()
+    kept_all = (det_all[0, :, 0] >= 0).sum()
+    kept_k = (det_k[0, :, 0] >= 0).sum()
+    assert kept_k <= 5 < kept_all
+
+
+def test_proposal_pre_nms_cut_and_padding():
+    """rpn_pre_nms_top_n restricts NMS candidates; short outputs cycle the
+    kept boxes (the reference's keep[i %% out_size] padding)."""
+    h = w = 4
+    k = 12
+    cls_prob = nd.array(rng.rand(1, 2 * k, h, w).astype(np.float32))
+    bbox_pred = nd.array(np.zeros((1, 4 * k, h, w), np.float32))
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois = nd.Proposal(cls_prob, bbox_pred, im_info,
+                       rpn_pre_nms_top_n=2, rpn_post_nms_top_n=8,
+                       threshold=0.01).asnumpy()
+    assert rois.shape == (8, 5)
+    # at most 2 distinct boxes can survive a 2-candidate NMS; padding
+    # cycles them, so every row equals one of the first two
+    uniq = np.unique(rois[:, 1:], axis=0)
+    assert len(uniq) <= 2
+    for row in rois:
+        assert (row[1:] == rois[0, 1:]).all() or (row[1:] == rois[1, 1:]).all()
+
+
 def test_proposal_shapes_and_clip():
     h = w = 4
     k = 12  # 4 scales x 3 ratios
