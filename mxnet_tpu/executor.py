@@ -226,8 +226,18 @@ class Executor:
         outputs = [values[(id(n), i)] for n, i in sym._outputs]
         return outputs, new_aux
 
+    @staticmethod
+    def _cast_u8(vals):
+        """uint8 inputs are compactly-shipped image bytes (ImageIter
+        dtype='uint8'): cast to float at the graph boundary — same rule as
+        the fused train step's on-device cast (train_step.py)."""
+        import jax.numpy as jnp
+
+        return [v.astype(jnp.float32) if v.dtype == jnp.uint8 else v
+                for v in vals]
+
     def _fwd_impl(self, arg_vals, aux_vals, rng, is_train, tap=None):
-        env_args = dict(zip(self._arg_names, arg_vals))
+        env_args = dict(zip(self._arg_names, self._cast_u8(arg_vals)))
         env_aux = dict(zip(self._aux_names, aux_vals))
         outs, new_aux = self._run_graph(env_args, env_aux, rng, is_train, tap)
         return outs, [new_aux[n] for n in self._aux_names]
@@ -243,6 +253,7 @@ class Executor:
         aux_names = self._aux_names
         reqs = self.grad_req
         env_aux_in = dict(zip(aux_names, aux_vals))
+        arg_vals = self._cast_u8(arg_vals)
         nograd = {n: v for n, v in zip(arg_names, arg_vals)
                   if n not in set(grad_names)}
 

@@ -273,7 +273,7 @@ _IMAGENET_STD = np.array([58.395, 57.12, 57.375])
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, pca_noise=0, inter_method=2,
-                    seed=None):
+                    seed=None, cast=True):
     """Assemble the standard training/eval chain: resize -> crop -> flip ->
     cast -> photometric -> normalize.
 
@@ -296,7 +296,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         chain.append(CenterCropAug(crop, inter_method, next(spawn)))
     if rand_mirror:
         chain.append(HorizontalFlipAug(0.5, next(spawn)))
-    chain.append(CastAug())
+    if cast:
+        chain.append(CastAug())
     if brightness or contrast or saturation:
         chain.append(ColorJitterAug(brightness, contrast, saturation,
                                     next(spawn)))
@@ -383,9 +384,14 @@ class ImageIter(DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", seed=None,
-                 preprocess_threads=4, **kwargs):
+                 preprocess_threads=4, dtype="float32", **kwargs):
         super().__init__(batch_size)
         self._rng = np.random.default_rng(seed)
+        # dtype="uint8": assemble and ship uint8 batches (4x less host ->
+        # device traffic; the compiled train step casts/normalizes on
+        # device).  The TPU-first input recipe: photometric/normalize
+        # augmenters need float and are rejected at batch time.
+        self._dtype = np.dtype(dtype)
         # parallel DECODE pool (the C++ reader's preprocess_threads analog,
         # iter_image_recordio.cc): cv2 imdecode releases the GIL so threads
         # overlap; augmentation stays on the caller thread because the
@@ -440,15 +446,26 @@ class ImageIter(DataIter):
             aug_keys = ("resize", "rand_crop", "rand_resize", "rand_mirror",
                         "mean", "std", "brightness", "contrast",
                         "saturation", "pca_noise", "inter_method")
+            if self._dtype == np.uint8:
+                for k in ("mean", "std", "brightness", "contrast",
+                          "saturation", "pca_noise"):
+                    v = kwargs.get(k)
+                    # mean/std arrive as arrays (ambiguous truth value)
+                    if v is not None and np.any(v):
+                        raise MXNetError(
+                            "dtype='uint8' keeps batches integral; "
+                            "%r needs float math — normalize on device "
+                            "instead (cast + scale in the graph)" % k)
             aug_list = CreateAugmenter(
-                data_shape, seed=seed,
+                data_shape, seed=seed, cast=self._dtype != np.uint8,
                 **{k: v for k, v in kwargs.items() if k in aug_keys})
         self.auglist = aug_list
 
         label_shape = (batch_size, label_width) if label_width > 1 \
             else (batch_size,)
         self.provide_data = [DataDesc(data_name,
-                                      (batch_size,) + self.data_shape)]
+                                      (batch_size,) + self.data_shape,
+                                      dtype=self._dtype)]
         self.provide_label = [DataDesc(label_name, label_shape)]
         self._cursor = 0
         self.reset()
@@ -532,7 +549,11 @@ class ImageIter(DataIter):
     # -- batching ----------------------------------------------------------
     def next(self):
         c, h, w = self.data_shape
-        images = np.zeros((self.batch_size, h, w, c), np.float32)
+        # assemble NCHW directly: one strided store per image instead of an
+        # NHWC store plus a whole-batch transposed copy (the assembly cost
+        # matters — on a 1-core host it was ~35% of pipeline time,
+        # benchmarks/bench_input_pipeline.py)
+        images = np.zeros((self.batch_size, c, h, w), self._dtype)
         label_shape = self.provide_label[0].shape
         labels = np.zeros(label_shape, np.float32)
         samples = self._collect_decoded(self.batch_size)
@@ -541,12 +562,21 @@ class ImageIter(DataIter):
                 img = np.repeat(img[:, :, None], c, axis=2)
             for aug in self.auglist:
                 img = aug(img)
+            if self._dtype == np.uint8 and img.dtype != np.uint8:
+                # a float augmenter slipped into a uint8 pipeline: numpy
+                # would wrap negatives modulo 256 silently — fail instead
+                raise MXNetError(
+                    "dtype='uint8' batch received a %s image from the "
+                    "augmenter chain; float augmenters (normalize/jitter) "
+                    "are incompatible — normalize on device instead"
+                    % img.dtype)
             if img.shape[:2] != (h, w):
-                img = _resize(img.astype(np.float32), w, h)
-            images[filled] = img
+                if self._dtype != np.uint8:
+                    img = img.astype(np.float32)
+                img = _resize(img, w, h)
+            images[filled] = img.transpose(2, 0, 1)
             labels[filled] = label
-        return DataBatch([nd.array(images.transpose(0, 3, 1, 2))],
-                         [nd.array(labels)],
+        return DataBatch([nd.array(images)], [nd.array(labels)],
                          pad=self.batch_size - len(samples))
 
 
@@ -555,7 +585,7 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
                     std_r=1, std_g=1, std_b=1, rand_crop=False,
                     rand_mirror=False, preprocess_threads=4, num_parts=1,
                     part_index=0, path_imgidx=None, prefetch_buffer=4,
-                    seed=None, **kwargs):
+                    seed=None, dtype="float32", **kwargs):
     """RecordIO image pipeline (C++ ``ImageRecordIter`` analog): ImageIter
     decode+augment wrapped in a prefetch thread double-buffer."""
     mean = np.array([mean_r, mean_g, mean_b]) \
@@ -569,7 +599,7 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=None,
                       shuffle=shuffle, rand_crop=rand_crop,
                       rand_mirror=rand_mirror, mean=mean, std=std,
                       num_parts=num_parts, part_index=part_index, seed=seed,
-                      preprocess_threads=preprocess_threads,
+                      preprocess_threads=preprocess_threads, dtype=dtype,
                       **{k: v for k, v in kwargs.items() if k in passthrough})
     return io_mod.PrefetchingIter(inner, capacity=prefetch_buffer)
 

@@ -155,6 +155,13 @@ class CompiledTrainStep:
         def cast(v):
             if cdtype is not None and jnp.issubdtype(v.dtype, jnp.floating):
                 return v.astype(cdtype)
+            if v.dtype == jnp.uint8:
+                # uint8 data = image bytes shipped compact (4x less h2d;
+                # ImageIter dtype="uint8"): cast on DEVICE to the compute
+                # dtype.  Integer label/id inputs keep their dtype — they
+                # arrive as s32/f32, never u8.
+                return v.astype(cdtype if cdtype is not None
+                                else jnp.float32)
             return v
 
         def step(params, slots, aux, data, lrs, wds, rescale, clip, extra,

@@ -1,0 +1,122 @@
+"""Worker program for the kill-a-worker recovery drill.
+
+Exercises the reference's recovery contract (kvstore_dist.h:39,77 +
+tests/nightly restart-and-resume): synchronized distributed training with
+per-epoch checkpoints and heartbeats; one worker is killed mid-run, the
+survivor detects it through the heartbeat registry and stops cleanly; the
+job is then relaunched with MXNET_IS_RECOVERY=1 on the restarted rank
+(startup barrier skipped), resumes from the last checkpoint, and trains to
+the target accuracy.
+
+Usage: python recovery_worker.py <rank> <nprocs> <coordinator> <workdir>
+       <phase: crash|resume>
+"""
+import os
+import sys
+import time
+
+rank, nprocs = int(sys.argv[1]), int(sys.argv[2])
+coordinator, workdir, phase = sys.argv[3], sys.argv[4], sys.argv[5]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd  # noqa: F401  (net eval path)
+from mxnet_tpu.parallel import health, launch
+
+launch.init(coordinator_address=coordinator, num_processes=nprocs,
+            process_id=rank)
+
+HB_DIR = os.environ["MXNET_HEARTBEAT_DIR"]
+PREFIX = os.path.join(workdir, "drill")
+TOTAL_EPOCHS = 10
+CRASH_EPOCH = 3      # rank 1 dies at the end of this epoch (0-based)
+
+kv = mx.kvstore.create("dist_sync")
+assert kv.rank == rank
+
+# identical disjoint-shard problem on every run (resume must continue it)
+shard_rng = np.random.RandomState(200 + rank)
+w_true = np.random.RandomState(11).normal(size=(6,)).astype(np.float32)
+xs = shard_rng.normal(size=(128, 6)).astype(np.float32)
+ys = (xs @ w_true > 0).astype(np.float32)
+
+net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                            name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+it = mx.io.NDArrayIter(xs, ys, batch_size=16)
+
+
+class PeerDied(Exception):
+    pass
+
+
+def epoch_cb(epoch, symbol, arg_params, aux_params):
+    # every rank checkpoints (weights are identical under dist_sync, and a
+    # surviving rank's file must exist whichever rank died)
+    mod.save_checkpoint(PREFIX + ".r%d" % rank, epoch)
+    with open(os.path.join(workdir, "epoch.r%d" % rank), "w") as f:
+        f.write(str(epoch))
+    if phase == "crash":
+        if rank == 1 and epoch == CRASH_EPOCH:
+            print("WORKER_1_SUICIDE", flush=True)
+            os.kill(os.getpid(), 9)
+        if rank == 0 and epoch >= CRASH_EPOCH:
+            # give the peer's heartbeat time to go stale, then check —
+            # the detection path a production launcher would poll
+            deadline = time.time() + 12
+            while time.time() < deadline:
+                time.sleep(0.5)
+                if health.dead_nodes(HB_DIR, nprocs, timeout=3.0):
+                    raise PeerDied()
+            raise AssertionError("peer death never detected")
+
+
+begin = 0
+arg_params = aux_params = None
+if phase == "resume":
+    # resume from the newest checkpoint either rank managed to write
+    epochs = []
+    for r in range(nprocs):
+        try:
+            with open(os.path.join(workdir, "epoch.r%d" % r)) as f:
+                epochs.append((int(f.read()), r))
+        except OSError:
+            pass
+    last_epoch, src = max(epochs)
+    _, arg_params, aux_params = mx.model.load_checkpoint(
+        PREFIX + ".r%d" % src, last_epoch)
+    begin = last_epoch + 1
+    assert begin >= CRASH_EPOCH, begin
+    # the relaunched job runs in recovery mode: every rank skips the
+    # startup barrier (XLA collectives need symmetric participation, so
+    # the asymmetric per-rank skip of the reference's server-mediated
+    # barrier maps to a job-wide recovery restart here)
+    assert health.is_recovery(), "relaunched job must see recovery flag"
+
+mod = mx.mod.Module(net, context=mx.cpu())
+mx.random.seed(5)
+try:
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+            arg_params=arg_params, aux_params=aux_params,
+            allow_missing=arg_params is None,
+            kvstore="dist_sync", begin_epoch=begin, num_epoch=TOTAL_EPOCHS,
+            epoch_end_callback=epoch_cb)
+except PeerDied:
+    print("WORKER_0_DETECTED_DEAD_PEER", flush=True)
+    # skip jax.distributed's atexit shutdown barrier: it would fatally
+    # abort waiting on the dead peer (the launcher restarts the whole job)
+    os._exit(0)
+
+it.reset()
+acc = dict(mod.score(it, "acc"))["accuracy"]
+assert acc >= 0.9, "rank %d accuracy %.3f" % (rank, acc)
+print("WORKER_%d_RESUMED_OK acc=%.3f" % (rank, acc), flush=True)

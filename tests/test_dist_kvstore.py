@@ -51,6 +51,57 @@ def test_dist_sync_kvstore_two_processes(nprocs, tmp_path):
         assert "WORKER_%d_OK" % rank in out
 
 
+def test_kill_worker_recovery_drill(tmp_path):
+    """The reference's recovery contract, executed for real: SIGKILL one of
+    two workers mid-training, the survivor detects the death through the
+    heartbeat registry and stops cleanly, then the job relaunches with
+    MXNET_IS_RECOVERY=1, resumes from the last per-epoch checkpoint, and
+    trains to the target accuracy (kvstore_dist.h:39,77 is_recovery +
+    manual-resume-from-checkpoint, SURVEY §5)."""
+    worker = os.path.join(os.path.dirname(__file__), "recovery_worker.py")
+    workdir = str(tmp_path / "drill")
+    os.makedirs(workdir)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def launch_phase(phase, extra_env):
+        coordinator = "localhost:%d" % _free_port()
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["MXNET_HEARTBEAT_DIR"] = str(tmp_path / ("hb_" + phase))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(extra_env)
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(r), "2", coordinator, workdir,
+             phase],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            text=True) for r in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return procs, outs
+
+    # phase 1: rank 1 SIGKILLs itself mid-training; rank 0 detects it
+    procs, outs = launch_phase("crash", {})
+    assert procs[1].returncode == -9, outs[1][-2000:]   # killed, not exited
+    assert "WORKER_1_SUICIDE" in outs[1]
+    assert procs[0].returncode == 0, outs[0][-4000:]
+    assert "WORKER_0_DETECTED_DEAD_PEER" in outs[0]
+    # a checkpoint from the crash epoch exists
+    assert any(f.startswith("epoch.") for f in os.listdir(workdir))
+
+    # phase 2: relaunch in recovery mode; resume from checkpoint, converge
+    procs, outs = launch_phase("resume", {"MXNET_IS_RECOVERY": "1"})
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d:\n%s" % (r, out[-4000:])
+        assert "WORKER_%d_RESUMED_OK" % r in out, out[-2000:]
+
+
 def test_launcher_env_contract(monkeypatch):
     """launch.init resolves the reference's DMLC_* env vars into
     jax.distributed.initialize arguments."""

@@ -153,6 +153,38 @@ def test_image_iter_from_rec():
         assert batch.label[0].shape == (4,)
 
 
+def test_imageiter_uint8_batches(tmp_path):
+    """dtype='uint8' ships integral batches (4x less h2d traffic; cast
+    happens on device) that match the float pipeline's values."""
+    rec_path = str(tmp_path / "u.rec")
+    idx_path = str(tmp_path / "u.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        img = rng.randint(0, 255, (8, 8, 3)).astype(np.uint8)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png",
+            quality=3))
+    writer.close()
+
+    def run(dtype):
+        it = mx.image.ImageIter(batch_size=4, data_shape=(3, 8, 8),
+                                path_imgrec=rec_path, path_imgidx=idx_path,
+                                shuffle=False, seed=0, dtype=dtype,
+                                preprocess_threads=0)
+        return next(iter(it))
+    b8 = run("uint8")
+    bf = run("float32")
+    assert b8.data[0].dtype == np.uint8
+    assert bf.data[0].dtype == np.float32
+    np.testing.assert_array_equal(
+        b8.data[0].asnumpy().astype(np.float32), bf.data[0].asnumpy())
+    with pytest.raises(mx.base.MXNetError, match="uint8"):
+        mx.image.ImageIter(batch_size=4, data_shape=(3, 8, 8),
+                           path_imgrec=rec_path, path_imgidx=idx_path,
+                           dtype="uint8", mean=True)
+
+
 def test_imageiter_num_parts_needs_keyed_source(tmp_path):
     """num_parts > 1 on a sequential (non-indexed) record file must raise:
     silently iterating the whole set would duplicate samples per worker."""
