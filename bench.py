@@ -130,7 +130,10 @@ def main():
 
         from mxnet_tpu import image as img_mod
 
-        cache = os.path.join(tempfile.gettempdir(), "mxtpu_bench_rec")
+        import getpass
+
+        cache = os.path.join(tempfile.gettempdir(),
+                             "mxtpu_bench_rec_" + getpass.getuser())
         os.makedirs(cache, exist_ok=True)
         rec, idx = _make_recordio_dataset(
             max(batch_size * 4, 512), cache)

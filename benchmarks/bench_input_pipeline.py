@@ -50,9 +50,9 @@ def bench_iter(path_rec, path_idx, batch, threads, epochs=3):
         shuffle=True, rand_crop=True, rand_mirror=True, seed=0,
         preprocess_threads=threads)
     n = 0
-    # warm epoch (thread pool spin-up, page cache)
+    # warm epoch (thread pool spin-up, page cache); don't count pad slots
     for b in it:
-        n += b.data[0].shape[0]
+        n += b.data[0].shape[0] - b.pad
     per_epoch = n
     t0 = time.perf_counter()
     for _ in range(epochs):

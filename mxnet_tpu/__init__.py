@@ -52,6 +52,7 @@ from . import module as mod
 from . import model
 from .model import FeedForward
 from . import predictor
+from . import rtc
 from .predictor import Predictor
 from . import rnn
 from . import parallel

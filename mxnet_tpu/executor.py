@@ -226,15 +226,21 @@ class Executor:
         outputs = [values[(id(n), i)] for n, i in sym._outputs]
         return outputs, new_aux
 
-    @staticmethod
-    def _cast_u8(vals):
-        """uint8 inputs are compactly-shipped image bytes (ImageIter
+    def _cast_u8(self, vals):
+        """uint8 DATA inputs are compactly-shipped image bytes (ImageIter
         dtype='uint8'): cast to float at the graph boundary — same rule as
-        the fused train step's on-device cast (train_step.py)."""
+        the fused train step's on-device cast (train_step.py).  Only names
+        in ``_u8_cast_names`` (set by the executor group from the bound
+        data descriptors) are touched, so deliberately-integral uint8
+        args (masks, custom-op bytes) keep their dtype."""
         import jax.numpy as jnp
 
-        return [v.astype(jnp.float32) if v.dtype == jnp.uint8 else v
-                for v in vals]
+        names = getattr(self, "_u8_cast_names", ())
+        if not names:
+            return vals
+        return [v.astype(jnp.float32)
+                if n in names and v.dtype == jnp.uint8 else v
+                for n, v in zip(self._arg_names, vals)]
 
     def _fwd_impl(self, arg_vals, aux_vals, rng, is_train, tap=None):
         env_args = dict(zip(self._arg_names, self._cast_u8(arg_vals)))

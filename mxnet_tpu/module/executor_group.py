@@ -243,6 +243,9 @@ class DataParallelExecutorGroup:
         # ops with GSPMD-opaque fast paths (pallas kernels) must fall back
         # when this executor's buffers are mesh-sharded
         exec_._mesh_active = self._mesh is not None
+        # uint8 DATA inputs (compact image batches) cast to float at the
+        # graph boundary; other uint8 args keep their dtype
+        exec_._u8_cast_names = set(self.data_names)
         # shard data args on the mesh; params replicate (or shard on the
         # model axis under tensor parallelism), grads/aux follow their param
         for name, arr in exec_.arg_dict.items():

@@ -314,20 +314,44 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchors):
         cls_id = jnp.argmax(fg, axis=0)                 # (A,)
         score = jnp.max(fg, axis=0)
         keep_score = score > thresh
-        if nms_topk > 0:
-            # only the top-k candidates by score enter NMS
-            # (ref multibox_detection.cc:125-127)
-            rank = _rank_desc(jnp.where(keep_score, score, -jnp.inf))
-            keep_score = keep_score & (rank < nms_topk)
-        order, keep_nms = _greedy_nms(
-            boxes, jnp.where(keep_score, score, 0.0), nms_thresh,
-            class_ids=None if force_suppress else cls_id)
-        kept = keep_nms & keep_score[order]
+        a = boxes.shape[0]
+        if 0 < nms_topk < a:
+            # only the top-k candidates by score enter NMS (ref
+            # multibox_detection.cc:125-127) — and the suppression scan
+            # runs over the k-row slice, not all anchors (k steps, k x k
+            # IoU: the detection-scale fast path, benchmarks/
+            # bench_detection.py)
+            order_full = jnp.argsort(
+                -jnp.where(keep_score, score, -jnp.inf))
+            top = order_full[:nms_topk]
+            torder, tkeep = _greedy_nms(
+                boxes[top], jnp.where(keep_score[top], score[top], 0.0),
+                nms_thresh,
+                class_ids=None if force_suppress else cls_id[top])
+            sorted_ids = jnp.concatenate([top[torder],
+                                          order_full[nms_topk:]])
+            kept = jnp.concatenate([
+                tkeep & keep_score[top][torder],
+                jnp.zeros(a - nms_topk, bool)])
+        else:
+            torder, keep_nms = _greedy_nms(
+                boxes, jnp.where(keep_score, score, 0.0), nms_thresh,
+                class_ids=None if force_suppress else cls_id)
+            sorted_ids = torder
+            kept = keep_nms & keep_score[torder]
         out = jnp.concatenate([
-            jnp.where(kept, cls_id[order].astype(jnp.float32), -1.0)[:, None],
-            score[order][:, None], boxes[order]], axis=1)
+            jnp.where(kept, cls_id[sorted_ids].astype(jnp.float32),
+                      -1.0)[:, None],
+            score[sorted_ids][:, None], boxes[sorted_ids]], axis=1)
         return out
 
+    # vmap materializes every image's (A, A) IoU matrix at once — at SSD
+    # scale (A=8732, bs 8) that is tens of GB; lax.map runs one image's
+    # matrices at a time (A^2 fp32 ~ 300 MB at SSD300 scale)
+    if anc_c.shape[0] > 2048:
+        import jax.lax as lax
+
+        return lax.map(lambda args: one(*args), (cls_prob, loc_pred))
     return jax.vmap(one)(cls_prob, loc_pred)
 
 

@@ -1,0 +1,141 @@
+"""Runtime device-kernel registration — the reference's RTC analog.
+
+The reference lets users hand the framework raw device-kernel source at
+runtime (``python/mxnet/rtc.py``: CUDA strings compiled via ``MXRtc*``,
+src/c_api/c_api.cc) and call it on NDArrays.  The TPU-native equivalent of
+"user-authored device kernel, compiled at runtime" is a **Pallas kernel**:
+the user writes the kernel in Python against ``jax.experimental.pallas``,
+and Mosaic compiles it for the TPU at first trace — same runtime-compile
+contract, memory-safe, and differentiable when the user supplies a
+backward.
+
+``register_pallas_op`` wires such a kernel into the op registry, so it is
+callable as ``mx.nd.<name>`` / ``mx.sym.<name>`` and composes with jit,
+vjp, Module training, and the rest of the framework exactly like built-in
+ops — the extension-point story the Custom op (host Python) cannot cover
+because its callbacks never run on the device.
+
+Worked example (see tests/test_rtc.py for the full differentiable one)::
+
+    import jax, jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def scale_add_kernel(x_ref, y_ref, o_ref, *, alpha):
+        o_ref[...] = x_ref[...] * alpha + y_ref[...]
+
+    def forward(x, y, alpha=2.0):
+        return pl.pallas_call(
+            functools.partial(scale_add_kernel, alpha=alpha),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x, y)
+
+    def backward(inputs, outputs, cotangents, alpha=2.0):
+        (g,) = cotangents
+        return [g * alpha, g]
+
+    mx.rtc.register_pallas_op("scale_add", forward, backward=backward,
+                              num_inputs=2,
+                              attr_params={"alpha": 2.0})
+    out = mx.nd.scale_add(a, b, alpha=3.0)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .attrs import Param, ParamSchema
+from .base import MXNetError
+from .registry import OpDef, register_op
+
+__all__ = ["register_pallas_op"]
+
+
+def register_pallas_op(name, forward, backward=None, num_inputs=1,
+                       num_outputs=1, infer_shape=None, attr_params=None,
+                       doc=""):
+    """Register a user device kernel as a first-class operator.
+
+    Args:
+      name: op name (becomes ``mx.nd.<name>`` / ``mx.sym.<name>``).
+      forward: ``forward(*inputs, **attrs) -> output(s)`` — jnp arrays in,
+        array or list out; typically wraps ``pl.pallas_call``.  Traced
+        under jit: Mosaic compiles the kernel at first use (the RTC
+        "compile at runtime" contract).
+      backward: optional ``backward(inputs, outputs, cotangents, **attrs)
+        -> [input cotangents]``.  When given, the op is differentiable
+        (wrapped in ``jax.custom_vjp``); without it, differentiating the
+        op raises at trace time (the reference's Rtc kernels are likewise
+        forward-only).
+      num_inputs / num_outputs: arity (ints).
+      infer_shape: optional ``(attrs, in_shapes, aux_shapes) ->
+        (in, out, aux)`` hook; defaults to abstract evaluation of
+        ``forward`` (fine for most kernels).
+      attr_params: {name: default} scalar attributes forwarded to both
+        ``forward`` and ``backward`` as keyword arguments.
+      doc: docstring for the generated wrappers.
+
+    The op name must not collide with an existing operator.
+    """
+    from .registry import get_op
+    from . import ndarray as nd_mod
+    from . import symbol as sym_mod
+
+    try:
+        get_op(name)
+    except (KeyError, MXNetError):
+        pass
+    else:
+        raise MXNetError("op %r already registered" % name)
+    # the wrappers install into mx.nd / mx.sym: refuse to shadow ANY
+    # existing attribute there (e.g. nd.array, sym.Variable)
+    if hasattr(nd_mod, name) or hasattr(sym_mod, name):
+        raise MXNetError(
+            "name %r would shadow an existing mx.nd/mx.sym attribute" % name)
+
+    attr_params = dict(attr_params or {})
+    schema = ParamSchema(*[Param(k, type(v), default=v)
+                           for k, v in attr_params.items()])
+
+    def _attrs(attrs):
+        return {k: attrs.get(k, d) for k, d in attr_params.items()}
+
+    def _as_list(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v]
+
+    def fcompute(attrs, inputs, aux, octx):
+        import jax
+
+        kw = _attrs(attrs)
+        if backward is None:
+            return _as_list(forward(*inputs, **kw)), []
+
+        @jax.custom_vjp
+        def run(*ins):
+            return tuple(_as_list(forward(*ins, **kw)))
+
+        def run_fwd(*ins):
+            outs = tuple(_as_list(forward(*ins, **kw)))
+            return outs, (ins, outs)
+
+        def run_bwd(res, cts):
+            ins, outs = res
+            grads = backward(list(ins), list(outs), list(cts), **kw)
+            if len(grads) != len(ins):
+                raise MXNetError(
+                    "%s.backward returned %d cotangents for %d inputs"
+                    % (name, len(grads), len(ins)))
+            return tuple(grads)
+
+        run.defvjp(run_fwd, run_bwd)
+        return list(run(*inputs)), []
+
+    register_op(OpDef(
+        name, fcompute, schema=schema,
+        num_inputs=num_inputs, num_outputs=num_outputs,
+        infer_shape=infer_shape, needs_train=False,
+        hint=name.lower(),
+        doc=doc or ("User-registered Pallas kernel op (rtc analog; "
+                    "reference python/mxnet/rtc.py).")))
+    # expose wrappers on the generated namespaces (ops registered after
+    # import must install their functions explicitly)
+    setattr(nd_mod, name, nd_mod._make_op_func(get_op(name)))
+    setattr(sym_mod, name, sym_mod._make_sym_func(name))
+    return get_op(name)

@@ -1,0 +1,272 @@
+// MXPred* C ABI — the reference's standalone predictor surface
+// (include/mxnet/c_predict_api.h:59-169: MXPredCreate / MXPredSetInput /
+// MXPredForward / MXPredGetOutputShape / MXPredGetOutput / MXPredFree)
+// re-hosted over the TPU framework's deployment artifact.
+//
+// The reference's client links libmxnet and feeds it symbol JSON + param
+// blobs; here the artifact is a serialized `jax.export` program
+// (Predictor.export) with the weights folded in, and the runtime hosted
+// behind this ABI is XLA via an embedded CPython — consumers of the C ABI
+// (this repo's predict_client.cc, or any language's FFI) never touch
+// Python themselves.  Deviations from the reference signature: the
+// artifact replaces (symbol_json, param_bytes), and input keys must be
+// given in the artifact's export feed order.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC predict_api.cc \
+//          $(python3-config --embed --cflags --libs) -o libmxtpu_predict.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string &m) { g_error = m; }
+
+// Helper module executed inside the embedded interpreter: owns the
+// deserialized executables and the staging buffers.
+const char *kHelperSrc = R"PY(
+import jax
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass  # backend may already be initialized by the embedding process
+import numpy as np
+from jax import export as jax_export
+
+_handles = {}
+_next = [1]
+
+def create(blob, keys):
+    exp = jax_export.deserialize(bytearray(blob))
+    h = _next[0]; _next[0] += 1
+    _handles[h] = {"exp": exp, "keys": list(keys), "in": {}, "out": None}
+    return h
+
+def set_input(h, key, mv, shape):
+    d = _handles[h]
+    if key not in d["keys"]:
+        raise KeyError("unknown input %r (artifact inputs: %s)"
+                       % (key, d["keys"]))
+    d["in"][key] = np.frombuffer(mv, np.float32).reshape(shape).copy()
+
+def forward(h):
+    d = _handles[h]
+    missing = [k for k in d["keys"] if k not in d["in"]]
+    if missing:
+        raise ValueError("inputs not set: %s" % missing)
+    args = [d["in"][k] for k in d["keys"]]
+    d["out"] = [np.asarray(o, dtype=np.float32) for o in
+                d["exp"].call(*args)]
+
+def out_ndim(h, i):
+    return len(_handles[h]["out"][i].shape)
+
+def out_shape(h, i):
+    return list(_handles[h]["out"][i].shape)
+
+def get_output(h, i, mv):
+    out = _handles[h]["out"][i].ravel()
+    dst = np.frombuffer(mv, np.float32)
+    if dst.size != out.size:
+        raise ValueError("output buffer size %d != %d" % (dst.size, out.size))
+    dst[:] = out
+
+def free(h):
+    _handles.pop(h, None)
+)PY";
+
+PyObject *g_helper = nullptr;
+
+bool ensure_python() {
+  if (g_helper != nullptr) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL the initializing thread holds, so MXPred* calls
+    // from ANY thread can PyGILState_Ensure without deadlocking
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject *mod = PyModule_New("_mxtpu_predict_embed");
+  PyObject *dict = PyModule_GetDict(mod);
+  PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
+  PyObject *res = PyRun_String(kHelperSrc, Py_file_input, dict, dict);
+  if (res == nullptr) {
+    PyErr_Print();
+    set_error("failed to initialize embedded predict runtime "
+              "(is jax importable? set PYTHONPATH to the site-packages "
+              "that hold jax)");
+    Py_DECREF(mod);
+    PyGILState_Release(gs);
+    return false;
+  }
+  Py_DECREF(res);
+  g_helper = mod;
+  PyGILState_Release(gs);
+  return true;
+}
+
+// Build an argument tuple from already-owned references; PyTuple_SetItem
+// STEALS each reference, so nothing here leaks (PyTuple_Pack would add
+// its own references on top of the fresh ones, leaking one per call).
+PyObject *pack_args(std::initializer_list<PyObject *> items) {
+  PyObject *t = PyTuple_New(static_cast<Py_ssize_t>(items.size()));
+  Py_ssize_t i = 0;
+  for (PyObject *o : items) PyTuple_SetItem(t, i++, o);
+  return t;
+}
+
+// Call helper.<name>(args...); returns new ref or nullptr (error set).
+PyObject *call(const char *name, PyObject *args) {
+  PyObject *fn = PyObject_GetAttrString(g_helper, name);
+  if (fn == nullptr) {
+    set_error(std::string("helper missing ") + name);
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (out == nullptr) {
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    PyObject *s = v ? PyObject_Str(v) : nullptr;
+    set_error(s ? PyUnicode_AsUTF8(s) : "embedded call failed");
+    Py_XDECREF(s);
+    Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+    return nullptr;
+  }
+  return out;
+}
+
+struct Pred {
+  long handle = 0;
+  std::vector<uint32_t> last_shape;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_error.c_str(); }
+
+// artifact: serialized jax.export blob (Predictor.export).  input_keys
+// must list the artifact's inputs in export feed order; shapes are given
+// CSR-style via indptr exactly as the reference's MXPredCreate.
+int MXPredCreate(const char *artifact, uint64_t artifact_len,
+                 int dev_type, int dev_id, uint32_t num_input_nodes,
+                 const char **input_keys, const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data, void **out) {
+  (void)dev_type; (void)dev_id; (void)input_shape_indptr;
+  (void)input_shape_data;
+  if (!ensure_python()) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject *blob = PyBytes_FromStringAndSize(artifact,
+                                             static_cast<Py_ssize_t>(artifact_len));
+  PyObject *keys = PyList_New(num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+  }
+  PyObject *res = call("create", pack_args({blob, keys}));
+  int rc = -1;
+  if (res != nullptr) {
+    Pred *p = new Pred();
+    p->handle = PyLong_AsLong(res);
+    Py_DECREF(res);
+    *out = p;
+    rc = 0;
+  }
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXPredSetInput(void *handle, const char *key, const float *data,
+                   uint32_t size, const uint32_t *shape, uint32_t ndim) {
+  Pred *p = static_cast<Pred *>(handle);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
+  PyObject *shp = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject *res = call("set_input",
+                       pack_args({PyLong_FromLong(p->handle),
+                                  PyUnicode_FromString(key), mv, shp}));
+  int rc = res ? 0 : -1;
+  Py_XDECREF(res);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXPredForward(void *handle) {
+  Pred *p = static_cast<Pred *>(handle);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject *res = call("forward",
+                       pack_args({PyLong_FromLong(p->handle)}));
+  int rc = res ? 0 : -1;
+  Py_XDECREF(res);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXPredGetOutputShape(void *handle, uint32_t index,
+                         uint32_t **shape_data, uint32_t *shape_ndim) {
+  Pred *p = static_cast<Pred *>(handle);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject *res = call("out_shape",
+                       pack_args({PyLong_FromLong(p->handle),
+                                  PyLong_FromUnsignedLong(index)}));
+  int rc = -1;
+  if (res != nullptr) {
+    Py_ssize_t n = PyList_Size(res);
+    p->last_shape.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      p->last_shape[i] = static_cast<uint32_t>(
+          PyLong_AsLong(PyList_GetItem(res, i)));
+    }
+    Py_DECREF(res);
+    *shape_data = p->last_shape.data();
+    *shape_ndim = static_cast<uint32_t>(n);
+    rc = 0;
+  }
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXPredGetOutput(void *handle, uint32_t index, float *data,
+                    uint32_t size) {
+  Pred *p = static_cast<Pred *>(handle);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject *mv = PyMemoryView_FromMemory(reinterpret_cast<char *>(data),
+                                         static_cast<Py_ssize_t>(size) * 4,
+                                         PyBUF_WRITE);
+  PyObject *res = call("get_output",
+                       pack_args({PyLong_FromLong(p->handle),
+                                  PyLong_FromUnsignedLong(index), mv}));
+  int rc = res ? 0 : -1;
+  Py_XDECREF(res);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXPredFree(void *handle) {
+  Pred *p = static_cast<Pred *>(handle);
+  if (g_helper != nullptr) {
+    PyGILState_STATE gs = PyGILState_Ensure();
+    PyObject *res = call("free",
+                         pack_args({PyLong_FromLong(p->handle)}));
+    Py_XDECREF(res);
+    PyGILState_Release(gs);
+  }
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+// Standalone C++ predict client — the reference's amalgamation/predict
+// story (image-classification/predict-cpp) re-done for the TPU artifact:
+// load a Predictor.export blob through the MXPred* C ABI (predict_api.cc),
+// read a batch of raw float32 records through the RecordIO C ABI
+// (recordio.cc), classify, print per-record argmax.  No Python written by
+// the consumer.
+//
+// Usage: predict_client <artifact> <recfile> <nrecords> <dim...>
+//   records hold raw little-endian float32 payloads of prod(dim) elements;
+//   the artifact's single input is named "data" with shape
+//   (nrecords, dim...).
+//
+// Build (see tests/test_predict_client.py):
+//   g++ -O2 -std=c++17 predict_client.cc predict_api.cc recordio.cc \
+//       $(python3-config --embed --cflags --libs) -o predict_client
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+const char *MXGetLastError();
+int MXPredCreate(const char *, uint64_t, int, int, uint32_t, const char **,
+                 const uint32_t *, const uint32_t *, void **);
+int MXPredSetInput(void *, const char *, const float *, uint32_t,
+                   const uint32_t *, uint32_t);
+int MXPredForward(void *);
+int MXPredGetOutputShape(void *, uint32_t, uint32_t **, uint32_t *);
+int MXPredGetOutput(void *, uint32_t, float *, uint32_t);
+int MXPredFree(void *);
+
+const char *rio_last_error();
+void *rio_reader_open(const char *);
+int rio_reader_next(void *, const void **, uint64_t *);
+int rio_reader_close(void *);
+}
+
+namespace {
+
+int die(const char *what, const char *detail) {
+  std::fprintf(stderr, "predict_client: %s: %s\n", what, detail);
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <artifact> <recfile> <nrecords> <dim...>\n",
+                 argv[0]);
+    return 2;
+  }
+  const char *artifact_path = argv[1];
+  const char *rec_path = argv[2];
+  uint32_t nrec = static_cast<uint32_t>(std::atoi(argv[3]));
+  std::vector<uint32_t> dims;
+  uint64_t per_rec = 1;
+  for (int i = 4; i < argc; ++i) {
+    dims.push_back(static_cast<uint32_t>(std::atoi(argv[i])));
+    per_rec *= dims.back();
+  }
+
+  // ---- artifact bytes
+  std::FILE *f = std::fopen(artifact_path, "rb");
+  if (!f) return die("open artifact", artifact_path);
+  std::fseek(f, 0, SEEK_END);
+  long len = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<char> blob(len);
+  if (std::fread(blob.data(), 1, len, f) != static_cast<size_t>(len)) {
+    std::fclose(f);
+    return die("read artifact", artifact_path);
+  }
+  std::fclose(f);
+
+  // ---- batch from recordio (raw float32 payloads)
+  void *reader = rio_reader_open(rec_path);
+  if (!reader) return die("open recordio", rio_last_error());
+  std::vector<float> batch(static_cast<size_t>(nrec) * per_rec);
+  for (uint32_t i = 0; i < nrec; ++i) {
+    const void *data = nullptr;
+    uint64_t dlen = 0;
+    if (rio_reader_next(reader, &data, &dlen) != 1) {
+      return die("read record", rio_last_error());
+    }
+    if (dlen != per_rec * 4) {
+      std::fprintf(stderr, "record %u: %llu bytes, want %llu\n", i,
+                   (unsigned long long)dlen,
+                   (unsigned long long)(per_rec * 4));
+      return 1;
+    }
+    std::memcpy(batch.data() + static_cast<size_t>(i) * per_rec, data,
+                dlen);
+  }
+  rio_reader_close(reader);
+
+  // ---- predict through the C ABI
+  std::vector<uint32_t> shape;
+  shape.push_back(nrec);
+  shape.insert(shape.end(), dims.begin(), dims.end());
+  uint32_t indptr[2] = {0, static_cast<uint32_t>(shape.size())};
+  const char *keys[1] = {"data"};
+  void *h = nullptr;
+  if (MXPredCreate(blob.data(), blob.size(), 1, 0, 1, keys, indptr,
+                   shape.data(), &h) != 0) {
+    return die("MXPredCreate", MXGetLastError());
+  }
+  if (MXPredSetInput(h, "data", batch.data(),
+                     static_cast<uint32_t>(batch.size()), shape.data(),
+                     static_cast<uint32_t>(shape.size())) != 0) {
+    return die("MXPredSetInput", MXGetLastError());
+  }
+  if (MXPredForward(h) != 0) return die("MXPredForward", MXGetLastError());
+
+  uint32_t *oshape = nullptr;
+  uint32_t ondim = 0;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) {
+    return die("MXPredGetOutputShape", MXGetLastError());
+  }
+  uint64_t osize = 1;
+  for (uint32_t i = 0; i < ondim; ++i) osize *= oshape[i];
+  std::vector<float> out(osize);
+  if (MXPredGetOutput(h, 0, out.data(),
+                      static_cast<uint32_t>(osize)) != 0) {
+    return die("MXPredGetOutput", MXGetLastError());
+  }
+
+  uint64_t classes = (ondim >= 2) ? osize / oshape[0] : 1;
+  for (uint32_t i = 0; i < nrec; ++i) {
+    const float *row = out.data() + static_cast<size_t>(i) * classes;
+    uint64_t best = 0;
+    for (uint64_t c = 1; c < classes; ++c) {
+      if (row[c] > row[best]) best = c;
+    }
+    std::printf("record %u: class %llu prob %.4f\n", i,
+                (unsigned long long)best, row[best]);
+  }
+  MXPredFree(h);
+  return 0;
+}

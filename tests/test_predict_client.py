@@ -1,0 +1,122 @@
+"""C++ predict client over the StableHLO artifact (src/predict_client.cc).
+
+Closes the "deploy without writing Python" path for real
+(c_predict_api.h:59-169 analog): a C++ program loads Predictor.export's
+artifact through the MXPred* C ABI, reads a raw-float RecordIO batch
+through the rio_* C ABI, and must print the same argmax classes the Python
+Predictor computes.
+"""
+import os
+import re
+import shutil
+import struct
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import recordio
+from mxnet_tpu.predictor import Predictor
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "src")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="native toolchain unavailable")
+
+
+def _build_client(out_dir):
+    exe = os.path.join(out_dir, "predict_client")
+    # one config binary for BOTH flag sets: mixing the venv's headers with
+    # the system's libpython would be an ABI mismatch
+    cfg = sys.executable + "-config"
+    if not shutil.which(cfg):
+        cfg = "python3-config"
+    cflags = subprocess.check_output([cfg, "--embed", "--cflags"],
+                                     text=True).split()
+    ldflags = subprocess.check_output([cfg, "--embed", "--ldflags"],
+                                      text=True).split()
+    cmd = (["g++", "-O2", "-std=c++17",
+            os.path.join(SRC, "predict_client.cc"),
+            os.path.join(SRC, "predict_api.cc"),
+            os.path.join(SRC, "recordio.cc")]
+           + cflags + ldflags + ["-o", exe])
+    subprocess.check_call(cmd)
+    return exe
+
+
+def test_cpp_client_matches_python_predictor(tmp_path):
+    # train a small classifier so the artifact is a real trained model
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(np.float32)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    np.random.seed(1)
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.3},
+            initializer=mx.initializer.Xavier(), num_epoch=12)
+
+    # export the deployment artifact for a batch-8 predictor
+    arg_params, aux_params = mod.get_params()
+    params = dict(arg_params)
+    params.update(aux_params)
+    pred = Predictor(net, params, input_shapes={"data": (8, 8)},
+                     ctx=mx.cpu())
+    artifact = str(tmp_path / "model.jaxexp")
+    pred.export(artifact)
+
+    # python-side reference predictions on one batch
+    batch = X[:8]
+    pred.forward(data=nd.array(batch))
+    py_cls = np.argmax(pred.get_output(0).asnumpy(), axis=1)
+
+    # the same batch as raw float32 records
+    rec_path = str(tmp_path / "batch.rec")
+    w = recordio.MXRecordIO(rec_path, "w")
+    for row in batch:
+        w.write(row.astype("<f4").tobytes())
+    w.close()
+
+    exe = _build_client(str(tmp_path))
+    env = dict(os.environ)
+    site = sysconfig.get_paths()["purelib"]
+    env["PYTHONPATH"] = site + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.check_output(
+        [exe, artifact, rec_path, "8", "8"], env=env, text=True,
+        stderr=subprocess.STDOUT, timeout=240)
+
+    got = {}
+    for m in re.finditer(r"record (\d+): class (\d+) prob ([0-9.]+)", out):
+        got[int(m.group(1))] = (int(m.group(2)), float(m.group(3)))
+    assert len(got) == 8, out
+    for i in range(8):
+        assert got[i][0] == py_cls[i], (i, got[i], py_cls[i], out)
+        assert 0.0 <= got[i][1] <= 1.0
+
+
+def test_cpp_client_bad_artifact_fails_cleanly(tmp_path):
+    exe = _build_client(str(tmp_path))
+    bad = str(tmp_path / "bad.jaxexp")
+    with open(bad, "wb") as f:
+        f.write(b"not an artifact")
+    rec_path = str(tmp_path / "empty.rec")
+    w = recordio.MXRecordIO(rec_path, "w")
+    w.write(struct.pack("<8f", *([0.0] * 8)))
+    w.close()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = sysconfig.get_paths()["purelib"] + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    proc = subprocess.run([exe, bad, rec_path, "1", "8"], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode != 0
+    assert "MXPredCreate" in proc.stderr or "artifact" in proc.stderr
