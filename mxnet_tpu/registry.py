@@ -76,6 +76,7 @@ class OpDef:
         doc="",
         visible=True,
         mesh_axes=None,
+        user_defined=False,
     ):
         self.name = name
         self.fcompute = fcompute
@@ -94,6 +95,9 @@ class OpDef:
         self.hint = hint or name.lstrip("_").lower()
         self.doc = doc
         self.visible = visible
+        # runtime-registered user kernels (mx.rtc): exempt from the
+        # first-party registry-coverage sweep
+        self.user_defined = user_defined
         # {argument_name: mesh_axis} — weights whose leading dim belongs on
         # a named mesh axis (e.g. MoE expert stacks on 'expert'); the mesh
         # executor reads this to shard the bound variables (op-level

@@ -131,7 +131,7 @@ def register_pallas_op(name, forward, backward=None, num_inputs=1,
         name, fcompute, schema=schema,
         num_inputs=num_inputs, num_outputs=num_outputs,
         infer_shape=infer_shape, needs_train=False,
-        hint=name.lower(),
+        hint=name.lower(), user_defined=True,
         doc=doc or ("User-registered Pallas kernel op (rtc analog; "
                     "reference python/mxnet/rtc.py).")))
     # expose wrappers on the generated namespaces (ops registered after

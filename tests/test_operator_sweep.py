@@ -757,11 +757,17 @@ TESTED_ELSEWHERE = {
 
 
 def test_every_registered_op_is_covered():
-    """Coverage tripwire: registering a new op without a test fails here."""
+    """Coverage tripwire: registering a new op without a test fails here.
+
+    User-registered runtime kernels (mx.rtc.register_pallas_op, e.g. the
+    ops tests/test_rtc.py installs at collection) are out of scope — the
+    tripwire guards first-party registry coverage."""
     from mxnet_tpu import registry
 
     covered = TESTED_HERE | set(TESTED_ELSEWHERE)
-    missing = [op for op in registry.list_ops() if op not in covered]
+    missing = [op for op in registry.list_ops()
+               if op not in covered
+               and not registry.get_op(op).user_defined]
     assert not missing, (
         "ops registered but untested (add to a sweep table or claim in "
         "TESTED_ELSEWHERE): %s" % sorted(missing))
