@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Matrix-factorization recommender (reference: example/recommenders).
+
+Capability parity with `example/recommenders/matrix_fact.py`: user/item
+Embeddings -> elementwise product -> sum = predicted rating, trained with
+LinearRegressionOutput under RMSE — through the legacy FeedForward API the
+reference uses, on synthetic MovieLens-shaped data (hermetic, no
+downloads).
+
+Run: python examples/matrix_factorization.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+
+def build(num_users, num_items, factors):
+    import mxnet_tpu as mx
+
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score_label")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=factors,
+                         name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items, output_dim=factors,
+                         name="item_embed")
+    dot = mx.sym.sum(u * v, axis=1)
+    return mx.sym.LinearRegressionOutput(dot, score, name="score")
+
+
+def synthetic_ratings(num_users, num_items, factors, n, seed=0):
+    """Low-rank ground truth + noise: learnable, MovieLens-shaped."""
+    rng = np.random.RandomState(seed)
+    U = rng.normal(0, 0.6, (num_users, factors)).astype(np.float32)
+    V = rng.normal(0, 0.6, (num_items, factors)).astype(np.float32)
+    users = rng.randint(0, num_users, n).astype(np.float32)
+    items = rng.randint(0, num_items, n).astype(np.float32)
+    scores = (U[users.astype(int)] * V[items.astype(int)]).sum(1)
+    scores += rng.normal(0, 0.1, n).astype(np.float32)
+    return users, items, scores
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=200)
+    ap.add_argument("--items", type=int, default=300)
+    ap.add_argument("--factors", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=40)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    logging.basicConfig(level=logging.INFO)
+    users, items, scores = synthetic_ratings(args.users, args.items,
+                                             args.factors, 8000)
+    split = 7000
+    train_it = mx.io.NDArrayIter(
+        {"user": users[:split], "item": items[:split]},
+        {"score_label": scores[:split]}, batch_size=250, shuffle=True)
+    val_it = mx.io.NDArrayIter(
+        {"user": users[split:], "item": items[split:]},
+        {"score_label": scores[split:]}, batch_size=250)
+
+    net = build(args.users, args.items, args.factors)
+    # legacy estimator API, as the reference example uses
+    model = mx.model.FeedForward(
+        symbol=net, ctx=mx.cpu(), num_epoch=args.epochs,
+        optimizer="adam", learning_rate=0.05,
+        initializer=mx.initializer.Normal(0.1))
+    model.fit(X=train_it, eval_data=val_it, eval_metric="rmse")
+
+    val_it.reset()
+    preds = model.predict(val_it)
+    rmse = float(np.sqrt(np.mean(
+        (preds.ravel()[:len(scores) - split] - scores[split:]) ** 2)))
+    print("final val RMSE: %.3f (noise floor ~0.1)" % rmse)
+
+
+if __name__ == "__main__":
+    main()
