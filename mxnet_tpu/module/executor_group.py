@@ -160,7 +160,10 @@ class DataParallelExecutorGroup:
         # Megatron column/row pairing for the 'model' axis, derived from one
         # graph walk (parallel/tp_rules.py) — one psum per FC/Conv pair
         # instead of the naive plan's per-layer all-gathers
-        self._tp_plan = {}
+        # None = planner didn't run (naive mode); {} = planner ran and found
+        # nothing shardable (replicate, do NOT fall back to the naive
+        # per-layer all-gather plan megatron mode exists to avoid)
+        self._tp_plan = None
         if self._model_par > 1:
             from .. import config as _config
 
@@ -196,7 +199,7 @@ class DataParallelExecutorGroup:
                 self._mesh, P(*([axis] + [None] * (len(shape) - 1))))
         if self._model_par <= 1 or not shape:
             return self._rep_sharding
-        if self._tp_plan:
+        if self._tp_plan is not None:
             spec = self._tp_plan.get(name)
             if spec is None or len(spec) != len(shape):
                 return self._rep_sharding

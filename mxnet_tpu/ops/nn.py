@@ -387,19 +387,34 @@ def register_all():
         with only the channel reductions in fp32.
         """
 
-        def stats(x, center):
+        def stats(x):
             # mean and variance in ONE fused reduction pass: jnp.var's
             # two-pass formulation costs an extra full read of x per BN —
             # measured 9% of the whole ResNet-50 step on the bench chip
             # (benchmarks/ROOFLINE.md).  The shifted-data formulation
-            # var = E[(x-c)^2] - (mean-c)^2 with c = moving_mean (a
-            # constant, so the subtraction fuses into the same pass) keeps
-            # fp32 from catastrophically cancelling when |mean| >> std:
-            # the moving mean tracks the batch mean, so the summed squares
-            # stay O(var) instead of O(mean^2).
+            # var = E[(x-c)^2] - (mean-c)^2 needs c near the batch mean
+            # to keep fp32 from catastrophically cancelling when
+            # |mean| >> std.  c comes from a one-slice subsample of the
+            # batch itself (last reduction axis, ~1/W of the data, fused
+            # as a tiny extra reduction) — NOT the moving mean, which
+            # initializes to zero and would degrade the formulation to
+            # E[x^2]-E[x]^2 exactly during the cold-start steps where
+            # unnormalized inputs make cancellation worst.
             red = tuple(i for i in range(x.ndim) if i != caxis)
             bshape = tuple(x.shape[caxis] if i == caxis else 1
                            for i in range(x.ndim))
+            if not red:
+                z = jnp.zeros(x.shape[caxis], jnp.float32)
+                return x.astype(jnp.float32).reshape(-1), z
+            # middle slice, not index 0: the border slice is systematically
+            # unrepresentative for zero-padded inputs (letterboxed images,
+            # leading-silence spectrograms), where center=0 would reinstate
+            # the very cancellation this estimate exists to avoid
+            sax = red[-1]
+            sample = jax.lax.index_in_dim(
+                x, x.shape[sax] // 2, sax, keepdims=True)
+            center = jax.lax.stop_gradient(
+                jnp.mean(sample.astype(jnp.float32), axis=red))
             xc = x.astype(jnp.float32) - center.reshape(bshape)
             mc = jnp.mean(xc, axis=red)
             var = jnp.maximum(jnp.mean(jnp.square(xc), axis=red)
@@ -415,13 +430,13 @@ def register_all():
             return x * scale.reshape(bshape) + shift.reshape(bshape)
 
         @jax.custom_vjp
-        def bn(x, gamma, beta, center):
-            mean, var = stats(x, center)
+        def bn(x, gamma, beta):
+            mean, var = stats(x)
             inv = jax.lax.rsqrt(var + eps)
             return apply(x, gamma, beta, mean, inv), mean, var
 
-        def bn_fwd(x, gamma, beta, center):
-            mean, var = stats(x, center)
+        def bn_fwd(x, gamma, beta):
+            mean, var = stats(x)
             inv = jax.lax.rsqrt(var + eps)
             return (apply(x, gamma, beta, mean, inv), mean, var), \
                 (x, gamma, mean, inv)
@@ -449,7 +464,7 @@ def register_all():
             dx = dx + (dmean_ct / n).reshape(bshape) \
                 + (dvar_ct * 2.0 / n).reshape(bshape) * xmu
             return dx.astype(x.dtype), dgamma.astype(gamma.dtype), \
-                dbeta.astype(gamma.dtype), jnp.zeros_like(mean)
+                dbeta.astype(gamma.dtype)
 
         bn.defvjp(bn_fwd, bn_bwd)
         return bn
@@ -475,9 +490,7 @@ def register_all():
                      - mean * inv * gamma.astype(jnp.float32)).astype(data.dtype)
             out = data * scale.reshape(bshape) + shift.reshape(bshape)
         else:
-            out, mean, var = _bn_train_core(eps, caxis)(
-                data, gamma, beta,
-                jax.lax.stop_gradient(moving_mean.astype(jnp.float32)))
+            out, mean, var = _bn_train_core(eps, caxis)(data, gamma, beta)
             new_mm = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
             new_mv = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
         return [out, mean, var], [new_mm, new_mv]

@@ -21,10 +21,34 @@ _DTYPE_BYTES = {
 
 _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
 
-_COLLECTIVE_RE = re.compile(
-    r"=\s*((?:\([^)]*\))|(?:[a-z][a-z0-9]*\[[0-9,]*\]\S*))\s+"
-    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+# an instruction line: '%name = SHAPE op(...)'.  SHAPE is extracted with a
+# balanced-paren scan, not a depth-limited regex: tuple shapes nest (grouped
+# async collectives carry tuples of buffers) and TPU layout annotations like
+# {1,0:T(8,128)} add parens at arbitrary depth inside them.
+_INSTR_RE = re.compile(r"=\s*")
+_OP_RE = re.compile(
+    r"\s*(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
     r"(-start|-done)?\(")
+
+
+def _scan_shape(line, start):
+    """Return (shape_str, end_index) for the shape beginning at `start` —
+    either a balanced parenthesized tuple or a single whitespace-free
+    token."""
+    if start < len(line) and line[start] == "(":
+        depth = 0
+        for i in range(start, len(line)):
+            if line[i] == "(":
+                depth += 1
+            elif line[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    return line[start:i + 1], i + 1
+        return line[start:], len(line)
+    m = re.match(r"\S+", line[start:])
+    if m is None:
+        return "", start
+    return m.group(0), start + m.end()
 
 
 def shape_bytes(shape_str):
@@ -42,6 +66,53 @@ def shape_bytes(shape_str):
     return total
 
 
+def _split_top_level(tuple_str):
+    """Split '(a, (b, c), d)' into top-level elements ['a', '(b, c)', 'd']."""
+    s = tuple_str.strip()
+    if not (s.startswith("(") and s.endswith(")")):
+        return [s]
+    s = s[1:-1]
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return [p.strip() for p in parts if p.strip()]
+
+
+def _start_bytes(op, shape_s):
+    """Result payload of an async '-start' tuple shape.
+
+    The tuple layout is op-specific (verified against compiled HLO):
+    ``all-reduce-start`` has the SAME shape as the sync op — a flat tuple
+    of results when XLA combined several all-reduces — so every buffer
+    counts.  ``all-gather-start`` / ``collective-permute-start`` carry
+    ``(operand(s), result(s), [u32 context scalars...])`` — count only
+    the result element (itself possibly a tuple for grouped ops).
+    Summing naively would double those; taking the single largest buffer
+    (the old rule) undercounts any grouped form.
+    """
+    parts = _split_top_level(shape_s)
+    parts = [p for p in parts
+             if not re.fullmatch(r"[su]32\[\]\S*", p)]  # context scalars
+    if not parts:
+        return 0
+    if op == "all-reduce":
+        return sum(shape_bytes(p) for p in parts)
+    if op in ("all-gather", "collective-permute") and len(parts) >= 2:
+        return shape_bytes(parts[1])
+    # generic async wrapper: ((operands...), results, ctx) — a leading
+    # tuple element marks the operand pack; otherwise flat results
+    if len(parts) >= 2 and parts[0].startswith("("):
+        return shape_bytes(parts[1])
+    return sum(shape_bytes(p) for p in parts)
+
+
 def collective_stats(hlo_text):
     """Count collectives and sum their result payloads.
 
@@ -49,16 +120,21 @@ def collective_stats(hlo_text):
     Returns {op_name: {"count": int, "bytes": int}} plus "total" entry.
     """
     stats = {}
-    for m in _COLLECTIVE_RE.finditer(hlo_text):
-        shape_s, op, suffix = m.group(1), m.group(2), m.group(3)
+    matches = []
+    for line in hlo_text.splitlines():
+        em = _INSTR_RE.search(line)
+        if em is None:
+            continue
+        shape_s, end = _scan_shape(line, em.end())
+        om = _OP_RE.match(line, end)
+        if om is None:
+            continue
+        matches.append((shape_s, om.group(1), om.group(2)))
+    for shape_s, op, suffix in matches:
         if suffix == "-done":
             continue
         if suffix == "-start":
-            # async start shapes are tuples holding operand-alias + result
-            # buffers (+ u32 context scalars); counting the whole tuple
-            # would double the payload — take the largest single buffer
-            nbytes = max((shape_bytes(s.group(0))
-                          for s in _SHAPE_RE.finditer(shape_s)), default=0)
+            nbytes = _start_bytes(op, shape_s)
         else:
             nbytes = shape_bytes(shape_s)
         entry = stats.setdefault(op, {"count": 0, "bytes": 0})

@@ -17,8 +17,12 @@
 
 #include <Python.h>
 
+#include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
+#include <thread>
 #include <string>
 #include <vector>
 
@@ -81,17 +85,49 @@ def free(h):
     _handles.pop(h, None)
 )PY";
 
-PyObject *g_helper = nullptr;
+std::atomic<PyObject *> g_helper{nullptr};
+std::mutex g_init_mu;
+// Guarded by the GIL (read/modified only between a PyGILState_Ensure and
+// the next potential GIL release).  No C++ mutex may be held across the
+// helper exec: PyRun_String imports jax/numpy, whose file I/O drops and
+// re-acquires the GIL internally — a mutex held there deadlocks against
+// any host thread that calls in with the GIL held (ctypes.PyDLL).
+std::atomic<bool> g_init_in_progress{false};
 
+// First-call initialization must be race-free: the ABI promises
+// thread-safe use, and two FFI threads hitting a naked null check could
+// both run Py_InitializeEx (UB) or leak a helper module.  A failed init
+// does NOT latch: a later call retries — e.g. after the caller fixes
+// PYTHONPATH, as the error message suggests.
 bool ensure_python() {
-  if (g_helper != nullptr) return true;
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // release the GIL the initializing thread holds, so MXPred* calls
-    // from ANY thread can PyGILState_Ensure without deadlocking
-    PyEval_SaveThread();
+  if (g_helper.load(std::memory_order_acquire) != nullptr) return true;
+  {
+    // interpreter bring-up only; no GIL interplay inside the lock (if
+    // another thread holds the GIL the interpreter is already
+    // initialized and this section is a no-op)
+    std::lock_guard<std::mutex> lock(g_init_mu);
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL the initializing thread holds, so MXPred* calls
+      // from ANY thread can PyGILState_Ensure without deadlocking
+      PyEval_SaveThread();
+    }
   }
   PyGILState_STATE gs = PyGILState_Ensure();
+  // Serialize the helper exec with a GIL-guarded claim: between the
+  // check and the store below the GIL is never released, so exactly one
+  // thread claims; waiters sleep WITHOUT holding the GIL or any lock.
+  while (g_init_in_progress.load(std::memory_order_relaxed)) {
+    Py_BEGIN_ALLOW_THREADS
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    Py_END_ALLOW_THREADS
+  }
+  if (g_helper.load(std::memory_order_acquire) != nullptr) {
+    PyGILState_Release(gs);
+    return true;
+  }
+  g_init_in_progress.store(true, std::memory_order_relaxed);
+  bool ok = false;
   PyObject *mod = PyModule_New("_mxtpu_predict_embed");
   PyObject *dict = PyModule_GetDict(mod);
   PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
@@ -102,13 +138,14 @@ bool ensure_python() {
               "(is jax importable? set PYTHONPATH to the site-packages "
               "that hold jax)");
     Py_DECREF(mod);
-    PyGILState_Release(gs);
-    return false;
+  } else {
+    Py_DECREF(res);
+    g_helper.store(mod, std::memory_order_release);
+    ok = true;
   }
-  Py_DECREF(res);
-  g_helper = mod;
+  g_init_in_progress.store(false, std::memory_order_relaxed);
   PyGILState_Release(gs);
-  return true;
+  return ok;
 }
 
 // Build an argument tuple from already-owned references; PyTuple_SetItem
@@ -123,7 +160,13 @@ PyObject *pack_args(std::initializer_list<PyObject *> items) {
 
 // Call helper.<name>(args...); returns new ref or nullptr (error set).
 PyObject *call(const char *name, PyObject *args) {
-  PyObject *fn = PyObject_GetAttrString(g_helper, name);
+  PyObject *helper = g_helper.load(std::memory_order_acquire);
+  if (helper == nullptr) {
+    set_error("predict runtime not initialized");
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *fn = PyObject_GetAttrString(helper, name);
   if (fn == nullptr) {
     set_error(std::string("helper missing ") + name);
     Py_XDECREF(args);
@@ -258,7 +301,7 @@ int MXPredGetOutput(void *handle, uint32_t index, float *data,
 
 int MXPredFree(void *handle) {
   Pred *p = static_cast<Pred *>(handle);
-  if (g_helper != nullptr) {
+  if (g_helper.load(std::memory_order_acquire) != nullptr) {
     PyGILState_STATE gs = PyGILState_Ensure();
     PyObject *res = call("free",
                          pack_args({PyLong_FromLong(p->handle)}));

@@ -216,9 +216,10 @@ def test_batchnorm_training():
 def test_batchnorm_large_mean_variance_stable():
     """One-pass variance must not catastrophically cancel at |mean|>>std.
 
-    The shifted-data formulation centers on the moving mean; once that has
-    warmed toward the batch mean, the recovered variance is accurate even
-    when E[x^2] is ~1e6 fp32-ulps above the true variance.
+    The shifted-data formulation centers on a subsample estimate of the
+    batch mean, so the recovered variance is accurate even when E[x^2]
+    is ~1e6 fp32-ulps above the true variance — including on the VERY
+    FIRST step, when the moving stats are still at their (0, 1) init.
     """
     data = sym.Variable("data")
     bn = sym.BatchNorm(data, fix_gamma=False, momentum=0.0, name="bn")
@@ -227,15 +228,18 @@ def test_batchnorm_large_mean_variance_stable():
     ex.arg_dict["data"][:] = x
     ex.arg_dict["bn_gamma"][:] = 1.0
     ex.arg_dict["bn_beta"][:] = 0.0
-    # momentum=0: the moving mean equals the batch mean after one step,
-    # so the second forward computes stats centered on the true mean
-    ex.forward(is_train=True)
-    out = ex.forward(is_train=True)[0].asnumpy()
     mean = x.mean(axis=(0, 2, 3), keepdims=True)
     var = x.var(axis=(0, 2, 3), keepdims=True)
     expected = (x - mean) / np.sqrt(var + 1e-3)
+    # cold start: moving stats at init (0, 1) — the subsample center must
+    # keep the fp32 sums at O(var), not O(mean^2)
+    out_cold = ex.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out_cold, expected, rtol=2e-2, atol=2e-2)
+    assert float(np.abs(out_cold).std()) > 0.5  # not a var=0 rsqrt(eps) blowup
+    # warmed up: identical result (center estimate is batch-local)
+    out = ex.forward(is_train=True)[0].asnumpy()
     assert_almost_equal(out, expected, rtol=2e-2, atol=2e-2)
-    assert float(np.abs(out).std()) > 0.5  # not collapsed by a var=0 clamp
+    assert float(np.abs(out).std()) > 0.5
 
 
 def test_dropout():

@@ -203,3 +203,44 @@ def test_tp_survives_reshape():
     mod.reshape([("data", (2, 10))], [("softmax_label", (2,))])
     mesh = mod._exec_group._mesh
     assert dict(mesh.shape)["model"] == 8
+
+
+def test_collective_stats_parsing():
+    """hlo_stats must parse the shapes real XLA emits, verbatim.
+
+    Layout-annotated tuples nest parens to depth 3 (`{1,0:T(8,128)}`);
+    grouped async starts carry tuples of buffers; all-reduce-start's shape
+    is a FLAT tuple of results (no operand-alias element) while
+    all-gather / collective-permute starts are (operands, results, ctx).
+    """
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
+
+    # grouped all-gather-start with TPU tiled layouts
+    s = collective_stats(
+        "%ag = ((f32[8,128]{1,0:T(8,128)}, f32[8,64]{1,0:T(8,128)}), "
+        "(f32[64,128]{1,0:T(8,128)}, f32[64,64]{1,0:T(8,128)})) "
+        "all-gather-start(%a, %b), dimensions={0}")
+    assert s["all-gather"] == {"count": 1, "bytes": (64 * 128 + 64 * 64) * 4}
+
+    # flat grouped all-reduce-start: every buffer is a result
+    s = collective_stats(
+        "%ar = (f32[100]{0}, f32[200]{0}) all-reduce-start(%a, %b), "
+        "to_apply=%sum")
+    assert s["all-reduce"]["bytes"] == 300 * 4
+
+    # sync grouped all-reduce (tuple shape) counts all results too
+    s = collective_stats(
+        "ROOT %r = (f32[1,100]{1,0}, f32[1,200]{1,0}) "
+        "all-reduce(%p2, %p3), channel_id=1")
+    assert s["all-reduce"]["bytes"] == 300 * 4
+
+    # collective-permute-start: operand alias + u32 context scalars excluded
+    cp = ("%cp = (f32[8,128]{1,0}, f32[8,128]{1,0}, u32[], u32[]) "
+          "collective-permute-start(%x), source_target_pairs={{0,1}}")
+    s = collective_stats(cp)
+    assert s["collective-permute"] == {"count": 1, "bytes": 8 * 128 * 4}
+
+    # -done lines do not double count
+    s = collective_stats(
+        cp + "\n%cpd = f32[8,128]{1,0} collective-permute-done(%cp)")
+    assert s["collective-permute"]["count"] == 1
