@@ -239,11 +239,16 @@ y = jnp.asarray(rng.randint(0, 1000, (N,)), jnp.int32)
 
 f = jax.jit(train, donate_argnums=(0, 1))
 if os.environ.get("COST", "0") == "1":
-    ca = f.lower(params, mom, x, y).compile().cost_analysis()
+    compiled = f.lower(params, mom, x, y).compile()
+    ca = compiled.cost_analysis()
     if isinstance(ca, list):
         ca = ca[0]
     print("raw", {k: ca.get(k) for k in ("flops", "bytes accessed")},
           flush=True)
+    hlo_out = os.environ.get("HLO_OUT")
+    if hlo_out:
+        with open(hlo_out, "w") as fh:
+            fh.write(compiled.as_text())
     raise SystemExit
 t0 = time.time()
 params, mom, loss = f(params, mom, x, y)

@@ -340,13 +340,16 @@ class PipelineModule(BaseModule):
 
         def pipe(sp, a, rng):
             def body(p, xx, key):
-                # distinct stochastic-op keys per stage (fold on the stage
-                # index); microbatches of one stage share a mask — the
-                # GPipe scan reuses one stage trace for all of them
-                skey = jax.random.fold_in(
+                stage_key = jax.random.fold_in(
                     key, jax.lax.axis_index("pipe"))
 
-                def run_stage(pdict, act):
+                def run_stage(pdict, act, mb_id):
+                    # distinct stochastic-op keys per (stage, microbatch):
+                    # fold the stage index, then the microbatch id the
+                    # schedule hands us, so each microbatch draws its own
+                    # dropout masks (reference semantics: a fresh mask per
+                    # forward call, src/operator/dropout-inl.h)
+                    skey = jax.random.fold_in(stage_key, mb_id)
                     env = dict(pdict)
                     env["data"] = act
                     return stage_fn(env, True, skey)[0]
@@ -423,10 +426,11 @@ class PipelineModule(BaseModule):
             sp = {n: params[n] for n in stage_names}
 
             def body(p, xx, key):
-                skey = jax.random.fold_in(
+                stage_key = jax.random.fold_in(
                     key, jax.lax.axis_index("pipe"))
 
-                def run_stage(pdict, act):
+                def run_stage(pdict, act, mb_id):
+                    skey = jax.random.fold_in(stage_key, mb_id)
                     env = dict(pdict)
                     env["data"] = act
                     return stage_fn(env, is_train, skey)[0]

@@ -94,7 +94,7 @@ def register_all():
             interpret = bool(_config.get("MXNET_PALLAS_INTERPRET"))
             on_tpu = jax.default_backend() == "tpu"
             if (on_tpu or interpret) \
-                    and _pa.supported(q.shape, k.shape, causal):
+                    and _pa.supported(q.shape, k.shape, causal, heads):
                 PATH_TAKEN["last"] = "flash"
                 out = _pa.sdpa_flash(q, k, v, heads, causal, scale,
                                      interpret=interpret and not on_tpu)

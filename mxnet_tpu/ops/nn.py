@@ -387,39 +387,51 @@ def register_all():
         with only the channel reductions in fp32.
         """
 
-        def stats(x):
+        def stats(x, center):
             # mean and variance in ONE fused reduction pass: jnp.var's
             # two-pass formulation costs an extra full read of x per BN —
             # measured 9% of the whole ResNet-50 step on the bench chip
             # (benchmarks/ROOFLINE.md).  The shifted-data formulation
-            # var = E[(x-c)^2] - (mean-c)^2 needs c near the batch mean
-            # to keep fp32 from catastrophically cancelling when
-            # |mean| >> std.  c comes from a one-slice subsample of the
-            # batch itself (last reduction axis, ~1/W of the data, fused
-            # as a tiny extra reduction) — NOT the moving mean, which
-            # initializes to zero and would degrade the formulation to
-            # E[x^2]-E[x]^2 exactly during the cold-start steps where
-            # unnormalized inputs make cancellation worst.
+            # var = E[(x-c)^2] - (mean-c)^2 centers on c = moving_mean: a
+            # CONSTANT, so the subtraction and both reductions fuse into
+            # x's producer (a data-dependent center — e.g. a subsample
+            # mean — would serialize a second pass over x, giving the
+            # two-pass cost back).  Once the moving mean has warmed toward
+            # the batch mean the fp32 sums stay O(var).  The cold-start
+            # hole (moving_mean at its zero init + |mean| >> std ->
+            # catastrophic cancellation, advisor round-4) is closed by a
+            # DETECTED fallback: when the recovered variance is within
+            # fp32 cancellation noise of the shifted mean square, a
+            # lax.cond pays one corrective pass with the exact batch mean
+            # as center.  The predicate only fires during those early
+            # pathological steps, so the steady-state cost is the fused
+            # single pass.
             red = tuple(i for i in range(x.ndim) if i != caxis)
             bshape = tuple(x.shape[caxis] if i == caxis else 1
                            for i in range(x.ndim))
             if not red:
                 z = jnp.zeros(x.shape[caxis], jnp.float32)
                 return x.astype(jnp.float32).reshape(-1), z
-            # middle slice, not index 0: the border slice is systematically
-            # unrepresentative for zero-padded inputs (letterboxed images,
-            # leading-silence spectrograms), where center=0 would reinstate
-            # the very cancellation this estimate exists to avoid
-            sax = red[-1]
-            sample = jax.lax.index_in_dim(
-                x, x.shape[sax] // 2, sax, keepdims=True)
-            center = jax.lax.stop_gradient(
-                jnp.mean(sample.astype(jnp.float32), axis=red))
             xc = x.astype(jnp.float32) - center.reshape(bshape)
             mc = jnp.mean(xc, axis=red)
-            var = jnp.maximum(jnp.mean(jnp.square(xc), axis=red)
-                              - jnp.square(mc), 0.0)
-            return mc + center, var
+            var_fast = jnp.maximum(jnp.mean(jnp.square(xc), axis=red)
+                                   - jnp.square(mc), 0.0)
+            mean = mc + center
+            # fp32 cancellation noise is ~1e-7 * (mean-c)^2; refine when it
+            # could exceed ~1% of the recovered variance.  The mc^2 > 0
+            # term keeps legitimately-zero-variance channels (dead ReLU
+            # features, constant pads) from firing the refine forever once
+            # the moving mean has converged onto them (mc -> 0).
+            mc2 = jnp.square(mc)
+            bad = jnp.any((var_fast <= 1e-5 * mc2) & (mc2 > 0))
+
+            def refine(_):
+                m = jax.lax.stop_gradient(mean).reshape(bshape)
+                return jnp.mean(jnp.square(x.astype(jnp.float32) - m),
+                                axis=red)
+
+            var = jax.lax.cond(bad, refine, lambda _: var_fast, None)
+            return mean, var
 
         def apply(x, gamma, beta, mean, inv):
             bshape = tuple(x.shape[caxis] if i == caxis else 1
@@ -430,13 +442,13 @@ def register_all():
             return x * scale.reshape(bshape) + shift.reshape(bshape)
 
         @jax.custom_vjp
-        def bn(x, gamma, beta):
-            mean, var = stats(x)
+        def bn(x, gamma, beta, center):
+            mean, var = stats(x, center)
             inv = jax.lax.rsqrt(var + eps)
             return apply(x, gamma, beta, mean, inv), mean, var
 
-        def bn_fwd(x, gamma, beta):
-            mean, var = stats(x)
+        def bn_fwd(x, gamma, beta, center):
+            mean, var = stats(x, center)
             inv = jax.lax.rsqrt(var + eps)
             return (apply(x, gamma, beta, mean, inv), mean, var), \
                 (x, gamma, mean, inv)
@@ -464,7 +476,7 @@ def register_all():
             dx = dx + (dmean_ct / n).reshape(bshape) \
                 + (dvar_ct * 2.0 / n).reshape(bshape) * xmu
             return dx.astype(x.dtype), dgamma.astype(gamma.dtype), \
-                dbeta.astype(gamma.dtype)
+                dbeta.astype(gamma.dtype), jnp.zeros_like(mean)
 
         bn.defvjp(bn_fwd, bn_bwd)
         return bn
@@ -490,7 +502,9 @@ def register_all():
                      - mean * inv * gamma.astype(jnp.float32)).astype(data.dtype)
             out = data * scale.reshape(bshape) + shift.reshape(bshape)
         else:
-            out, mean, var = _bn_train_core(eps, caxis)(data, gamma, beta)
+            out, mean, var = _bn_train_core(eps, caxis)(
+                data, gamma, beta,
+                jax.lax.stop_gradient(moving_mean.astype(jnp.float32)))
             new_mm = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
             new_mv = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
         return [out, mean, var], [new_mm, new_mv]

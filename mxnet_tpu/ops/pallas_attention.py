@@ -50,6 +50,22 @@ def _pick_block(pref, t):
     return b
 
 
+def _out_sds(shape, dtype, *inputs):
+    """ShapeDtypeStruct for a pallas output, carrying the union of the
+    inputs' varying-mesh-axes (vma) when tracing inside shard_map — the
+    ring path calls these kernels per-device with 'seq'-varying blocks,
+    and shard_map's vma checking requires outputs to declare it."""
+    import jax
+
+    try:
+        vma = frozenset().union(*[jax.typeof(a).vma for a in inputs])
+    except (AttributeError, TypeError):
+        vma = frozenset()
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _lane_tile(x, n):
     """(rows, LANES) residual with all lanes equal -> (rows, n)."""
     import jax.numpy as jnp
@@ -151,11 +167,11 @@ def _fwd_call(q, k, v, scale, causal, interpret, with_lse):
 
     kernel = functools.partial(_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk, with_lse=with_lse)
-    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_shape = [_out_sds(q.shape, q.dtype, q, k, v)]
     out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
     if with_lse:
         out_shape.append(
-            jax.ShapeDtypeStruct((bh, t, LANES), jnp.float32))
+            _out_sds((bh, t, LANES), jnp.float32, q, k, v))
         out_specs.append(
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)))
     res = pl.pallas_call(
@@ -309,7 +325,7 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret):
                                   causal=causal, block_q=bq, block_k=bk)
     dq = pl.pallas_call(
         dq_kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_out_sds(q.shape, q.dtype, q, k, v, do, lse, delta),
         grid=(bh, t // bq, t // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),       # q
@@ -328,8 +344,8 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret):
                                    causal=causal, block_q=bq, block_k=bk)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_shape=[_out_sds(k.shape, k.dtype, q, k, v, do, lse, delta),
+                   _out_sds(v.shape, v.dtype, q, k, v, do, lse, delta)],
         grid=(bh, t // bk, t // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # q
@@ -388,18 +404,23 @@ def flash_attention(q, k, v, scale, causal=False, interpret=False):
                         bool(interpret))
 
 
-def supported(q_shape, k_shape, causal):
+def supported(q_shape, k_shape, causal, num_heads=1):
     """Whether the kernel handles these shapes (self-attention, T a
     multiple of the 128 sublane/lane tile, lane-friendly head dim).
     ``_pick_block`` shrinks the preferred block sizes to divide any such
-    T, so 128-alignment is the only sequence-length constraint."""
+    T, so 128-alignment is the only sequence-length constraint.  The lane
+    check is on the PER-HEAD dim (E/num_heads) — the kernel operates on
+    head-folded (B*H, T, E/H) blocks, so E=512/H=16 (head_dim 32) must
+    fall back even though E itself is lane-aligned."""
     bh, tq, d = q_shape
     tk = k_shape[1]
     if tq != tk:                       # cross-attention: fallback
         return False
     if tq % 128:                       # tile-aligned T only
         return False
-    if d % 64 != 0:                    # lane-unfriendly heads: fallback
+    if num_heads <= 0 or d % num_heads:
+        return False
+    if (d // num_heads) % 64 != 0:     # lane-unfriendly heads: fallback
         return False
     return True
 

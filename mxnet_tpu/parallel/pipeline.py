@@ -37,7 +37,11 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches):
     """Run a stage-per-device pipeline; call under ``shard_map``.
 
     Args:
-      stage_fn: ``(params, activation) -> activation`` for ONE stage.
+      stage_fn: ``(params, activation, mb_id) -> activation`` for ONE
+        stage.  ``mb_id`` is the (traced int32) microbatch index this call
+        processes — fold it into any stochastic-op RNG key so each
+        microbatch draws its own masks; ignore it for deterministic
+        stages.
       stage_params: this device's slice of the stage-stacked params — under
         ``shard_map`` with ``P('pipe', ...)`` in_spec each device receives a
         leading dim of 1; it is squeezed before calling ``stage_fn``.
@@ -63,7 +67,8 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches):
         stage_params)
 
     def probe(mb):
-        return jax.eval_shape(lambda p, a: stage_fn(p, a), params, mb)
+        return jax.eval_shape(lambda p, a: stage_fn(p, a, jnp.int32(0)),
+                              params, mb)
 
     out_sd = probe(jax.eval_shape(lambda v: v[0], x))
     assert tuple(out_sd.shape) == tuple(x.shape[1:]), \
@@ -84,13 +89,13 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches):
         # FLOPs in the bubble, matching GPipe.
         mb = x[jnp.clip(s, 0, m - 1)]
         inp = jnp.where(idx == 0, mb, state)
-        out = stage_fn(params, inp)
         # microbatch id at this device this step: s - idx, valid in [0, m)
         mb_id = s - idx
+        pos = jnp.clip(mb_id, 0, m - 1)
+        out = stage_fn(params, inp, pos)
         valid = jnp.logical_and(mb_id >= 0, mb_id < m)
         # last stage records its result
         write = jnp.logical_and(valid, idx == n - 1)
-        pos = jnp.clip(mb_id, 0, m - 1)
         buf = lax.dynamic_update_index_in_dim(
             buf, jnp.where(write, out, buf[pos]), pos, 0)
         # hand off to the next stage

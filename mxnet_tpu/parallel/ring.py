@@ -26,6 +26,11 @@ import numpy as np
 
 __all__ = ["ring_attention", "dense_attention"]
 
+# which per-hop compute the last ring_attention trace used ("flash" |
+# "streaming") — path-selection tripwire, same pattern as
+# ops.attention.PATH_TAKEN
+RING_PATH = {"last": None}
+
 
 def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
     """Single-device reference: the ``dot_product_attention`` op's own
@@ -39,7 +44,7 @@ def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
 
 
 def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
-                   scale=None):
+                   scale=None, use_flash=None, interpret=None):
     """Blockwise ring attention over the ``axis_name`` mesh axis.
 
     Args are the LOCAL sequence blocks (B, T_local, E).  Device i starts
@@ -49,6 +54,14 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     exact flash-attention numerics, and causal masking uses the global
     block offsets, so the result equals dense attention on the gathered
     sequence.
+
+    Per-hop compute dispatches to the Pallas flash kernel
+    (``ops.pallas_attention``) when the local block fits it (T_local
+    tile-aligned, head_dim lane-aligned) — the fused kernel IS the
+    distributed path, mirroring the reference's cuDNN-RNN-everywhere
+    precedent (src/operator/cudnn_rnn-inl.h) — and falls back to jnp
+    streaming math otherwise.  ``use_flash`` forces the choice;
+    ``interpret`` runs the kernels in interpreter mode (CPU tests).
     """
     import jax
     import jax.numpy as jnp
@@ -60,6 +73,24 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     hd = e // num_heads
     ev = v.shape[2] // num_heads
     scale = scale or 1.0 / np.sqrt(hd)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if use_flash is None:
+        # auto: the real kernel on TPU whenever the local block fits it;
+        # interpreter-mode emulation is opt-in (tests), not a default.
+        # Eligibility delegates to the kernel's own gate (ONE copy of the
+        # rule); the ring additionally requires ev == hd (the kernel's
+        # folded blocks assume one value width)
+        from ..ops import pallas_attention as _pa
+
+        use_flash = (jax.default_backend() == "tpu" and ev == hd
+                     and _pa.supported(q.shape, k.shape, causal, num_heads))
+    if use_flash:
+        RING_PATH["last"] = "flash"
+        return _ring_flash_fn(axis_name, bool(causal), float(scale),
+                              bool(interpret), num_heads)(q, k, v)
+    RING_PATH["last"] = "streaming"
 
     qh = q.reshape(b, t_local, num_heads, hd) * scale
     kh = k.reshape(b, t_local, num_heads, hd)
@@ -105,3 +136,165 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     denom = jnp.where(l == 0.0, 1.0, l)
     out = (acc / denom.transpose(0, 2, 1)[..., None]).astype(v.dtype)
     return out.reshape(b, t_local, v.shape[2])
+
+
+_RING_FLASH_CACHE = {}
+
+
+def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
+    """custom_vjp-wrapped flash ring: forward runs a ring of forward flash
+    kernels whose per-block (out, lse) partials merge with logsumexp
+    weights; backward runs a second ring of the backward kernels using the
+    GLOBAL lse/delta (the true softmax denominators), with dK/dV
+    accumulators rotating in lockstep with their K/V blocks so each
+    block's gradient arrives home after n hops.  Per hop, ``lax.switch``
+    picks full / causal-diagonal / skip compute from the block's global
+    offset — the causal skip saves the same ~2x the kernel's internal
+    block skipping does, one ring-hop coarser."""
+    key = (axis_name, causal, scale, interpret, num_heads)
+    hit = _RING_FLASH_CACHE.get(key)
+    if hit is not None:
+        return hit
+
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import pallas_attention as pa
+
+    def fold(x, b, t, h, hd):
+        return x.reshape(b, t, h, hd).transpose(0, 2, 1, 3) \
+            .reshape(b * h, t, hd)
+
+    def unfold(x, b, t, h, hd):
+        return x.reshape(b, h, t, hd).transpose(0, 2, 1, 3) \
+            .reshape(b, t, h * hd)
+
+    def fwd_pass(q, k, v):
+        n = lax.psum(1, axis_name)
+        idx = lax.axis_index(axis_name)
+        b, tl, e = q.shape
+        hd = e // num_heads
+        qf = fold(q, b, tl, num_heads, hd)
+        kb = fold(k, b, tl, num_heads, hd)
+        vb = fold(v, b, tl, num_heads, hd)
+        bh = b * num_heads
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        neg_inf = jnp.float32(-jnp.inf)
+
+        def full_blk(args):
+            qq, kk, vv = args
+            ob, lb = pa._fwd_call(qq, kk, vv, scale, False, interpret,
+                                  with_lse=True)
+            return ob.astype(jnp.float32), lb[:, :, 0]
+
+        def diag_blk(args):
+            qq, kk, vv = args
+            ob, lb = pa._fwd_call(qq, kk, vv, scale, True, interpret,
+                                  with_lse=True)
+            return ob.astype(jnp.float32), lb[:, :, 0]
+
+        def skip_blk(args):
+            return (jnp.zeros((bh, tl, hd), jnp.float32),
+                    jnp.full((bh, tl), neg_inf, jnp.float32))
+
+        # streaming merge state: o_w = sum_b out_b * exp(lse_b - m),
+        # l_w = sum_b exp(lse_b - m), m = running max of block lses
+        o_w = jnp.zeros((bh, tl, hd), jnp.float32)
+        l_w = jnp.zeros((bh, tl), jnp.float32)
+        m = jnp.full((bh, tl), neg_inf, jnp.float32)
+        for r in range(n):
+            src = (idx - r) % n
+            if causal:
+                case = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+                ob, lseb = lax.switch(case, [full_blk, diag_blk, skip_blk],
+                                      (qf, kb, vb))
+            else:
+                ob, lseb = full_blk((qf, kb, vb))
+            m_new = jnp.maximum(m, lseb)
+            m_safe = jnp.where(m_new == neg_inf, 0.0, m_new)
+            c = jnp.where(m == neg_inf, 0.0, jnp.exp(m - m_safe))
+            cb = jnp.where(lseb == neg_inf, 0.0, jnp.exp(lseb - m_safe))
+            o_w = o_w * c[..., None] + ob * cb[..., None]
+            l_w = l_w * c + cb
+            m = m_new
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+        denom = jnp.where(l_w == 0.0, 1.0, l_w)
+        of = (o_w / denom[..., None])
+        lse = jnp.where(l_w == 0.0, neg_inf, m + jnp.log(denom))
+        out = unfold(of.astype(v.dtype), b, tl, num_heads, hd)
+        return out, of, lse
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        out, _, _ = fwd_pass(q, k, v)
+        return out
+
+    def rf_fwd(q, k, v):
+        out, of, lse = fwd_pass(q, k, v)
+        return out, (q, k, v, of, lse)
+
+    def rf_bwd(res, do):
+        q, k, v, of, lse = res
+        n = lax.psum(1, axis_name)
+        idx = lax.axis_index(axis_name)
+        b, tl, e = q.shape
+        hd = e // num_heads
+        bh = b * num_heads
+        qf = fold(q, b, tl, num_heads, hd)
+        kb = fold(k, b, tl, num_heads, hd)
+        vb = fold(v, b, tl, num_heads, hd)
+        dof = fold(do, b, tl, num_heads, hd)
+        ofd = of.astype(qf.dtype)  # _bwd_call recomputes delta from do*o
+        lse3 = jnp.broadcast_to(lse[..., None], (bh, tl, pa.LANES))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def full_blk(args):
+            qq, kk, vv = args
+            dq_b, dk_b, dv_b = pa._bwd_call(qq, kk, vv, ofd, lse3, dof,
+                                            scale, False, interpret)
+            return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
+                    dv_b.astype(jnp.float32))
+
+        def diag_blk(args):
+            qq, kk, vv = args
+            dq_b, dk_b, dv_b = pa._bwd_call(qq, kk, vv, ofd, lse3, dof,
+                                            scale, True, interpret)
+            return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
+                    dv_b.astype(jnp.float32))
+
+        def skip_blk(args):
+            z = jnp.zeros((bh, tl, hd), jnp.float32)
+            return z, z, z
+
+        dq = jnp.zeros((bh, tl, hd), jnp.float32)
+        dkb = jnp.zeros((bh, tl, hd), jnp.float32)
+        dvb = jnp.zeros((bh, tl, hd), jnp.float32)
+        for r in range(n):
+            src = (idx - r) % n
+            if causal:
+                case = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+                dq_b, dk_b, dv_b = lax.switch(
+                    case, [full_blk, diag_blk, skip_blk], (qf, kb, vb))
+            else:
+                dq_b, dk_b, dv_b = full_blk((qf, kb, vb))
+            dq = dq + dq_b
+            dkb = dkb + dk_b
+            dvb = dvb + dv_b
+            # gradient accumulators travel WITH their K/V blocks; after n
+            # rotations each block's gradient is back at its owner
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+            dkb = lax.ppermute(dkb, axis_name, perm)
+            dvb = lax.ppermute(dvb, axis_name, perm)
+        dq_out = unfold(dq, b, tl, num_heads, hd).astype(q.dtype)
+        dk_out = unfold(dkb, b, tl, num_heads, hd).astype(k.dtype)
+        dv_out = unfold(dvb, b, tl, num_heads, hd).astype(v.dtype)
+        return dq_out, dk_out, dv_out
+
+    rf.defvjp(rf_fwd, rf_bwd)
+    _RING_FLASH_CACHE[key] = rf
+    return rf

@@ -46,8 +46,24 @@ from jax import export as jax_export
 _handles = {}
 _next = [1]
 
-def create(blob, keys):
+def create(blob, keys, shapes):
     exp = jax_export.deserialize(bytearray(blob))
+    avals = list(exp.in_avals)
+    if len(keys) != len(avals):
+        raise ValueError("artifact expects %d inputs, %d keys declared"
+                         % (len(avals), len(keys)))
+    if shapes is not None:
+        # the caller declared per-input shapes (c_predict_api.h CSR
+        # contract): honor them by checking against the artifact rather
+        # than silently ignoring them
+        if len(shapes) != len(avals):
+            raise ValueError("declared %d input shapes, artifact expects %d"
+                             % (len(shapes), len(avals)))
+        for key, shp, av in zip(keys, shapes, avals):
+            if tuple(shp) != tuple(av.shape):
+                raise ValueError(
+                    "declared shape %s for input %r does not match the "
+                    "artifact's %s" % (tuple(shp), key, tuple(av.shape)))
     h = _next[0]; _next[0] += 1
     _handles[h] = {"exp": exp, "keys": list(keys), "in": {}, "out": None}
     return h
@@ -200,13 +216,16 @@ const char *MXGetLastError() { return g_error.c_str(); }
 
 // artifact: serialized jax.export blob (Predictor.export).  input_keys
 // must list the artifact's inputs in export feed order; shapes are given
-// CSR-style via indptr exactly as the reference's MXPredCreate.
+// CSR-style via indptr exactly as the reference's MXPredCreate
+// (c_predict_api.h:59-103) and are VALIDATED against the artifact — a
+// mismatch fails here with a clean error instead of at forward.  Passing
+// nullptr for both shape arrays skips the check (shapes then come from
+// MXPredSetInput).
 int MXPredCreate(const char *artifact, uint64_t artifact_len,
                  int dev_type, int dev_id, uint32_t num_input_nodes,
                  const char **input_keys, const uint32_t *input_shape_indptr,
                  const uint32_t *input_shape_data, void **out) {
-  (void)dev_type; (void)dev_id; (void)input_shape_indptr;
-  (void)input_shape_data;
+  (void)dev_type; (void)dev_id;
   if (!ensure_python()) return -1;
   PyGILState_STATE gs = PyGILState_Ensure();
   PyObject *blob = PyBytes_FromStringAndSize(artifact,
@@ -215,7 +234,23 @@ int MXPredCreate(const char *artifact, uint64_t artifact_len,
   for (uint32_t i = 0; i < num_input_nodes; ++i) {
     PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
   }
-  PyObject *res = call("create", pack_args({blob, keys}));
+  PyObject *shapes;
+  if (input_shape_indptr != nullptr && input_shape_data != nullptr) {
+    shapes = PyList_New(num_input_nodes);
+    for (uint32_t i = 0; i < num_input_nodes; ++i) {
+      uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject *shp = PyTuple_New(hi - lo);
+      for (uint32_t j = lo; j < hi; ++j) {
+        PyTuple_SetItem(shp, j - lo,
+                        PyLong_FromUnsignedLong(input_shape_data[j]));
+      }
+      PyList_SetItem(shapes, i, shp);
+    }
+  } else {
+    shapes = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *res = call("create", pack_args({blob, keys, shapes}));
   int rc = -1;
   if (res != nullptr) {
     Pred *p = new Pred();

@@ -216,10 +216,12 @@ def test_batchnorm_training():
 def test_batchnorm_large_mean_variance_stable():
     """One-pass variance must not catastrophically cancel at |mean|>>std.
 
-    The shifted-data formulation centers on a subsample estimate of the
-    batch mean, so the recovered variance is accurate even when E[x^2]
-    is ~1e6 fp32-ulps above the true variance — including on the VERY
-    FIRST step, when the moving stats are still at their (0, 1) init.
+    The shifted-data formulation centers on the moving mean (a constant,
+    so the stats pass fuses into x's producer); when that center is far
+    from the batch mean — the VERY FIRST step, moving stats at their
+    (0, 1) init — a detected-cancellation lax.cond pays one corrective
+    pass with the exact batch mean, so the recovered variance is accurate
+    even when E[x^2] is ~1e6 fp32-ulps above the true variance.
     """
     data = sym.Variable("data")
     bn = sym.BatchNorm(data, fix_gamma=False, momentum=0.0, name="bn")

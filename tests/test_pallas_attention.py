@@ -53,6 +53,37 @@ def test_supported_gate():
     assert not pa.supported((4, 100, 64), (4, 100, 64), False)  # T < tile
     assert not pa.supported((4, 256, 48), (4, 256, 48), False)  # odd head dim
     assert not pa.supported((4, 128, 64), (4, 256, 64), False)  # cross-attn
+    # the gate is on the PER-HEAD dim: E=512 is lane-aligned, but at 16
+    # heads the kernel would see 32-wide blocks
+    assert pa.supported((4, 256, 512), (4, 256, 512), False, num_heads=8)
+    assert not pa.supported((4, 256, 512), (4, 256, 512), False,
+                            num_heads=16)
+    assert not pa.supported((4, 256, 512), (4, 256, 512), False,
+                            num_heads=3)  # E % heads != 0
+
+
+def test_op_dispatch_gates_on_head_dim(pallas_interpret_flag):
+    """head_dim 32 (E=256, heads=8) must take einsum; head_dim 64 and 128
+    (heads=4, heads=2 at the same E) must take flash — through the real op
+    dispatch, not the gate function alone."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.ops.attention import PATH_TAKEN
+
+    rng = np.random.RandomState(11)
+    b, t, e = 2, 128, 256
+    arrs = [rng.normal(size=(b, t, e)).astype(np.float32) for _ in range(3)]
+    for heads, expect in [(8, "einsum"), (4, "flash"), (2, "flash")]:
+        s = sym.dot_product_attention(sym.Variable("q"), sym.Variable("k"),
+                                      sym.Variable("v"), num_heads=heads)
+        ex = s.simple_bind(mx.cpu(), q=(b, t, e), k=(b, t, e), v=(b, t, e),
+                           grad_req="null")
+        for name, val in zip("qkv", arrs):
+            ex.arg_dict[name]._set_data(np.asarray(val))
+        PATH_TAKEN["last"] = None
+        ex.forward(is_train=False)
+        ex.outputs[0].asnumpy()
+        assert PATH_TAKEN["last"] == expect, \
+            (heads, e // heads, PATH_TAKEN["last"])
 
 
 @pytest.mark.parametrize("t", [128, 256])

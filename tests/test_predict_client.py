@@ -104,6 +104,44 @@ def test_cpp_client_matches_python_predictor(tmp_path):
         assert 0.0 <= got[i][1] <= 1.0
 
 
+def test_cpp_client_shape_mismatch_fails_at_create(tmp_path):
+    """MXPredCreate HONORS input_shape_indptr/data (c_predict_api.h:59-103):
+    declaring shapes that don't match the artifact must fail with a clean
+    error at create time, not a Python traceback at forward."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 8))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+    params = dict(arg_params)
+    params.update(aux_params)
+    pred = Predictor(net, params, input_shapes={"data": (8, 8)},
+                     ctx=mx.cpu())
+    artifact = str(tmp_path / "model.jaxexp")
+    pred.export(artifact)
+
+    # 4 records -> the client declares shape (4, 8) against a batch-8
+    # artifact
+    rec_path = str(tmp_path / "four.rec")
+    w = recordio.MXRecordIO(rec_path, "w")
+    for _ in range(4):
+        w.write(struct.pack("<8f", *([0.5] * 8)))
+    w.close()
+
+    exe = _build_client(str(tmp_path))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = sysconfig.get_paths()["purelib"] + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([exe, artifact, rec_path, "4", "8"], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode != 0
+    assert "MXPredCreate" in proc.stderr
+    assert "does not match" in proc.stderr, proc.stderr
+    assert "Traceback" not in proc.stderr, proc.stderr
+
+
 def test_cpp_client_bad_artifact_fails_cleanly(tmp_path):
     exe = _build_client(str(tmp_path))
     bad = str(tmp_path / "bad.jaxexp")
