@@ -26,9 +26,6 @@ _DTYPE_BYTES = {
 }
 
 _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\S.*?)\s+"
-    r"([a-z][a-z0-9\-]*(?:\.\d+)?)\(", re.M)
 
 
 def shape_nbytes(shape_str):
@@ -184,7 +181,8 @@ def raw_hlo(layout="NCHW", bn="onepass"):
     import tempfile
 
     path = os.path.join(os.path.dirname(__file__), "rn50_raw.py")
-    out = tempfile.mktemp(suffix=".hlo")
+    fd, out = tempfile.mkstemp(suffix=".hlo")
+    os.close(fd)
     env = dict(os.environ)
     env.update(LAYOUT=layout, BN=bn, COST="1", HLO_OUT=out)
     res = subprocess.run([sys.executable, path], env=env,

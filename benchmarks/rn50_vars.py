@@ -83,18 +83,29 @@ def _stats_onepass(x32):
     return m, v
 
 
-def _bn_custom_core():
+def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
     """The framework's _bn_train_core formulation (ops/nn.py): centered
-    one-pass stats + cond cancellation guard, hand-written backward."""
+    one-pass stats + cond cancellation guard, hand-written backward.
+    ``nocond`` drops the guard, ``nocenter`` additionally drops the
+    center subtraction, ``autodiff`` keeps the stats formulation but lets
+    XLA derive the backward — cost-isolation knobs."""
 
     def stats(x, center):
         bshape = (1, x.shape[1], 1, 1)
-        xc = x.astype(jnp.float32) - center.reshape(bshape)
+        x32 = x.astype(jnp.float32)
+        if nocenter:
+            xc = x32
+            center = jnp.zeros_like(center)
+        else:
+            xc = x32 - center.reshape(bshape)
         mc = jnp.mean(xc, axis=(0, 2, 3))
         var_fast = jnp.maximum(jnp.mean(jnp.square(xc), axis=(0, 2, 3))
                                - jnp.square(mc), 0.0)
         mean = mc + center
-        bad = jnp.any(var_fast <= 1e-5 * jnp.square(mc))
+        if nocond:
+            return mean, var_fast
+        mc2 = jnp.square(mc)
+        bad = jnp.any((var_fast <= 1e-5 * mc2) & (1e-7 * mc2 > EPS))
 
         def refine(_):
             m = jax.lax.stop_gradient(mean).reshape(bshape)
@@ -109,6 +120,15 @@ def _bn_custom_core():
         scale = (inv * gamma).astype(x.dtype)
         shift = (beta - mean * inv * gamma).astype(x.dtype)
         return x * scale.reshape(bshape) + shift.reshape(bshape)
+
+    if autodiff:
+        # same stats formulation, XLA-derived backward (full BN
+        # semantics: gradients flow through mean/var like the base path)
+        def bn_ad(x, gamma, beta, center):
+            mean, var = stats(x, center)
+            inv = jax.lax.rsqrt(var + EPS)
+            return apply(x, gamma, beta, mean, inv), mean, var
+        return bn_ad
 
     @jax.custom_vjp
     def bn(x, gamma, beta, center):
@@ -127,6 +147,23 @@ def _bn_custom_core():
         dy, dmean_ct, dvar_ct = cts
         bshape = (1, x.shape[1], 1, 1)
         n = x.shape[0] * x.shape[2] * x.shape[3]
+        if LEANBWD:
+            # dx = A*dy + B*x + C with per-channel coefficients from TWO
+            # fused reductions (sum dy, sum dy*x) — no full-size f32
+            # xmu/xhat temporaries, dx emitted in the compute dtype
+            dy32 = dy.astype(jnp.float32)
+            sum_dy = jnp.sum(dy32, axis=(0, 2, 3))
+            sum_dyx = jnp.sum(dy32 * x.astype(jnp.float32), axis=(0, 2, 3))
+            dbeta = sum_dy
+            dgamma = inv * (sum_dyx - mean * sum_dy)
+            a = inv * gamma
+            b = -(inv * inv) * gamma * dgamma / n + 2.0 * dvar_ct / n
+            c = -a * dbeta / n + (inv * inv) * gamma * mean * dgamma / n \
+                + dmean_ct / n - 2.0 * dvar_ct * mean / n
+            dx = (a.reshape(bshape).astype(x.dtype) * dy
+                  + b.reshape(bshape).astype(x.dtype) * x
+                  + c.reshape(bshape).astype(x.dtype))
+            return dx, dgamma, dbeta, jnp.zeros_like(mean)
         xmu = x.astype(jnp.float32) - mean.reshape(bshape)
         xhat = xmu * inv.reshape(bshape)
         dy32 = dy.astype(jnp.float32)
@@ -143,18 +180,22 @@ def _bn_custom_core():
     return bn
 
 
-_BN_CUSTOM = _bn_custom_core()
+LEANBWD = os.environ.get("LEANBWD", "0") == "1"
 
 
 def make_forward(cfg):
     bn_data, with_aux, smout, bn_custom = (
         cfg["bn_data"], cfg["aux"], cfg["smout"], cfg["bn_custom"])
+    bn_core = _bn_custom_core(cfg.get("nocond", False),
+                              cfg.get("nocenter", False),
+                              cfg.get("autodiff", False)) \
+        if bn_custom else None
 
     def bn_relu(p, aux_in, aux_out, name, x, relu=True):
         if bn_custom:
             center = jax.lax.stop_gradient(aux_in[name + "_mm"]) \
                 if with_aux else jnp.zeros((x.shape[1],), jnp.float32)
-            y, m, v = _BN_CUSTOM(x, p[name + "_g"], p[name + "_b"], center)
+            y, m, v = bn_core(x, p[name + "_g"], p[name + "_b"], center)
         else:
             m, v = _stats_onepass(x.astype(jnp.float32))
             inv = lax.rsqrt(v + EPS)
@@ -246,7 +287,8 @@ def run(tag, cfg, iters=15):
     return dt
 
 
-BASE = {"bn_data": False, "aux": False, "smout": False, "bn_custom": False}
+BASE = {"bn_data": False, "aux": False, "smout": False, "bn_custom": False,
+        "nocond": False, "nocenter": False, "autodiff": False}
 
 VARIANTS = {
     "base": {},
@@ -255,6 +297,10 @@ VARIANTS = {
     "smout": {"smout": True},
     "bn_custom": {"bn_custom": True},
     "bn_custom+aux": {"bn_custom": True, "aux": True},
+    "bn_custom_nocond": {"bn_custom": True, "nocond": True},
+    "bn_custom_nocenter": {"bn_custom": True, "nocond": True,
+                           "nocenter": True},
+    "bn_centered_autodiff": {"bn_custom": True, "autodiff": True},
     "all": {"bn_data": True, "aux": True, "smout": True,
             "bn_custom": True},
 }

@@ -205,7 +205,8 @@ class Executor:
                     node_rng = jax.device_put(node_rng, dev)
             octx = OpContext(is_train=is_train, rng=node_rng,
                              mesh_active=getattr(self, "_mesh_active",
-                                                 False))
+                                                 False),
+                             mesh=getattr(self, "_mesh", None))
             with jax.named_scope(node.name):
                 if spans:
                     with _prof.Scope(node.name):

@@ -244,8 +244,10 @@ class DataParallelExecutorGroup:
                                      type_dict=type_dict, shared_exec=shared_exec,
                                      **kwargs)
         # ops with GSPMD-opaque fast paths (pallas kernels) must fall back
-        # when this executor's buffers are mesh-sharded
+        # when this executor's buffers are mesh-sharded; ops with
+        # mesh-aware shardings (sparse MoE dispatch) get the mesh itself
         exec_._mesh_active = self._mesh is not None
+        exec_._mesh = self._mesh
         # uint8 DATA inputs (compact image batches) cast to float at the
         # graph boundary; other uint8 args keep their dtype
         exec_._u8_cast_names = set(self.data_names)

@@ -69,34 +69,116 @@ def _moe_forward(x, wg, w1, b1, w2, b2, num_experts):
     return y.reshape(orig_shape), aux_loss
 
 
+def _moe_forward_sparse(x, wg, w1, b1, w2, b2, num_experts,
+                        capacity_factor, mesh=None):
+    """Capacity-based sparse dispatch: per-step FLOPs FLAT in num_experts.
+
+    Each expert owns a fixed-capacity slot table C = ceil(cf * N / E); a
+    token takes the next slot of its chosen expert and tokens past
+    capacity are DROPPED (Switch Transformer semantics; the residual
+    connection around the MoE layer carries them).  Dispatch and combine
+    are gathers over a static (E*C) slot table — no (N, E) one-hot
+    matmuls, so the expert FFN compute is 2*cf*N*(dh+hd) regardless of E,
+    where the dense fallback pays E times that.
+
+    Under a mesh with an 'expert' axis the expert-major tensors carry
+    explicit sharding constraints, so each device computes only its own
+    experts' slots and GSPMD inserts the token exchange (all-to-all /
+    collective-permute family) at the dispatch/combine boundaries.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    e = num_experts
+    orig_shape = x.shape
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)
+    n = xt.shape[0]
+    c = max(1, int(np.ceil(capacity_factor * n / e)))
+
+    probs = jax.nn.softmax(xt @ wg, axis=-1)
+    choice = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(choice, e, dtype=xt.dtype)
+    gate = (probs * onehot).sum(-1)
+
+    # position of each token in its expert's queue (arrival order) —
+    # counted in int32: a bf16 activation-dtype cumsum loses exact
+    # integers past 256 and would silently collide slots on big batches
+    oh32 = onehot.astype(jnp.int32)
+    pos = ((jnp.cumsum(oh32, axis=0) - 1) * oh32).sum(-1)
+    keep = pos < c
+    flat_slot = choice.astype(jnp.int32) * c + jnp.minimum(pos, c - 1)
+
+    # slot -> token table; sentinel n points at a zero pad row
+    scatter_idx = jnp.where(keep, flat_slot, e * c)
+    slot_tok = jnp.full((e * c,), n, jnp.int32) \
+        .at[scatter_idx].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    xpad = jnp.concatenate([xt, jnp.zeros((1, d), xt.dtype)], axis=0)
+    xd = jnp.take(xpad, slot_tok, axis=0).reshape(e, c, d)
+
+    if mesh is not None and dict(mesh.shape).get("expert", 1) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        espec = NamedSharding(mesh, P("expert"))
+        xd = jax.lax.with_sharding_constraint(xd, espec)
+    h = jnp.einsum("ecd,edh->ech", xd, w1) + b1[:, None, :]
+    h = jnp.maximum(h, 0.0)
+    ye = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+    if mesh is not None and dict(mesh.shape).get("expert", 1) > 1:
+        ye = jax.lax.with_sharding_constraint(ye, espec)
+
+    # combine: each kept token reads back its slot; dropped tokens emit 0
+    flat = ye.reshape(e * c, d)
+    yt = jnp.take(flat, jnp.minimum(flat_slot, e * c - 1), axis=0)
+    yt = yt * keep[:, None].astype(yt.dtype) * gate[:, None]
+
+    frac = onehot.mean(0)
+    imp = probs.mean(0)
+    aux_loss = (frac * imp).sum() * e
+    return yt.reshape(orig_shape), aux_loss
+
+
 def register_all():
     import jax
 
     _wrapped = {}
 
-    def _moe_with_aux_grad(num_experts, coeff):
+    def _moe_with_aux_grad(num_experts, coeff, capacity_factor, mesh):
         """custom_vjp wrapper: forward value is y alone; backward is the
         vjp of (y + coeff * aux_loss), i.e. training minimizes
         task_loss + coeff * balance_loss with exact gradients."""
-        key = (num_experts, coeff)
+        # key by the mesh's VALUE (axes + device ids), not id(): id-keying
+        # grows the cache (and pins a Mesh) for every rebind in a
+        # long-running job; equal meshes share one traced closure
+        mesh_key = None if mesh is None else (
+            tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat))
+        key = (num_experts, coeff, capacity_factor, mesh_key)
         fn = _wrapped.get(key)
         if fn is not None:
             return fn
 
+        def fwd_impl(x, wg, w1, b1, w2, b2):
+            if capacity_factor > 0:
+                return _moe_forward_sparse(x, wg, w1, b1, w2, b2,
+                                           num_experts, capacity_factor,
+                                           mesh)
+            return _moe_forward(x, wg, w1, b1, w2, b2, num_experts)
+
         @jax.custom_vjp
         def moe(x, wg, w1, b1, w2, b2):
-            y, _ = _moe_forward(x, wg, w1, b1, w2, b2, num_experts)
+            y, _ = fwd_impl(x, wg, w1, b1, w2, b2)
             return y
 
         def fwd(x, wg, w1, b1, w2, b2):
-            y, _ = _moe_forward(x, wg, w1, b1, w2, b2, num_experts)
+            y, _ = fwd_impl(x, wg, w1, b1, w2, b2)
             return y, (x, wg, w1, b1, w2, b2)
 
         def bwd(res, dy):
             import jax.numpy as jnp
 
             def total(x, wg, w1, b1, w2, b2):
-                y, aux = _moe_forward(x, wg, w1, b1, w2, b2, num_experts)
+                y, aux = fwd_impl(x, wg, w1, b1, w2, b2)
                 return y, aux
 
             (_, aux), vjp = jax.vjp(total, *res)
@@ -109,7 +191,9 @@ def register_all():
 
     def fcompute(attrs, inputs, aux, octx):
         fn = _moe_with_aux_grad(attrs["num_experts"],
-                                float(attrs["aux_loss_coeff"]))
+                                float(attrs["aux_loss_coeff"]),
+                                float(attrs["capacity_factor"]),
+                                octx.mesh)
         return [fn(*inputs)], []
 
     register_op(OpDef(
@@ -120,6 +204,12 @@ def register_all():
             Param("aux_loss_coeff", float, default=0.01,
                   doc="weight of the Switch load-balancing loss folded "
                       "into the backward pass; 0 disables"),
+            Param("capacity_factor", float, default=0.0,
+                  doc="> 0 enables SPARSE capacity-based dispatch: each "
+                      "expert processes at most ceil(cf*N/E) tokens "
+                      "(overflow tokens drop, Switch semantics) and the "
+                      "per-step FLOPs are flat in num_experts; 0 keeps "
+                      "the dense all-expert oracle"),
         ),
         num_inputs=6,
         arguments=["data", "gate_weight", "expert1_weight",

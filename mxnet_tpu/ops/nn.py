@@ -418,12 +418,16 @@ def register_all():
                                    - jnp.square(mc), 0.0)
             mean = mc + center
             # fp32 cancellation noise is ~1e-7 * (mean-c)^2; refine when it
-            # could exceed ~1% of the recovered variance.  The mc^2 > 0
-            # term keeps legitimately-zero-variance channels (dead ReLU
-            # features, constant pads) from firing the refine forever once
-            # the moving mean has converged onto them (mc -> 0).
+            # could exceed ~1% of the recovered variance AND the variance
+            # it may have destroyed matters relative to eps (noise below
+            # eps can't move rsqrt(var + eps) meaningfully).  The second
+            # term also retires the guard for legitimately-zero-variance
+            # channels (dead ReLU features, constant pads): as the moving
+            # mean converges onto them, mc^2 falls below eps/1e-7 and the
+            # refine stops firing instead of paying the second pass on
+            # every step forever.
             mc2 = jnp.square(mc)
-            bad = jnp.any((var_fast <= 1e-5 * mc2) & (mc2 > 0))
+            bad = jnp.any((var_fast <= 1e-5 * mc2) & (1e-7 * mc2 > eps))
 
             def refine(_):
                 m = jax.lax.stop_gradient(mean).reshape(bshape)

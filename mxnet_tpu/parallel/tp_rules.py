@@ -109,6 +109,36 @@ def plan_tensor_parallel(symbol):
                 for anode, _ in aux_ins:
                     plan[anode.name] = ("model",)
                 out_state = "feat"
+        elif name == "Pooling":
+            # pooling reduces spatial dims only — the channel dim (NCHW or
+            # NHWC alike) is untouched, so a feature-sharded activation
+            # stays feature-sharded through it (round-4 verdict: the walk
+            # reset here and an all-gather appeared after every pool)
+            out_state = instate(ins[0])
+        elif name == "Embedding":
+            # Megatron vocab-dim sharding: each device holds a vocab slice,
+            # GSPMD realizes the lookup as masked-local-gather + one psum,
+            # and the REPLICATED output lets the following q/k/v
+            # projections start column-parallel (feature-dim sharding here
+            # would instead force them row-parallel: three psums where the
+            # attention block needs one)
+            wnode = ins[1][0]
+            if wnode.is_variable:
+                plan[wnode.name] = ("model", None)
+                out_state = "rep"
+        elif name == "dot_product_attention":
+            # Megatron attention: with q/k/v all feature-sharded (their
+            # projections column-parallel over heads), each device computes
+            # attention for ITS head group locally — the op's (B,T,E) ->
+            # (B,T,H,hd) reshape maps an E-split to an H-split — and the
+            # output stays 'feat', so the out-projection becomes
+            # row-parallel and the whole block costs ONE psum.  Head-count
+            # divisibility by the mesh axis is GSPMD's to realize; a
+            # non-divisible split degrades to resharding, never to wrong
+            # numbers.
+            sts = [instate(e) for e in ins]
+            out_state = "feat" if sts and all(s == "feat" for s in sts) \
+                else "rep"
         elif name in ELEMENTWISE_OPS:
             sts = [instate(e) for e in ins]
             out_state = "feat" if sts and all(s == "feat" for s in sts) \

@@ -35,14 +35,19 @@ _OPS = {}
 class OpContext:
     """Per-invocation execution context: train flag + PRNG key +
     whether the enclosing executor runs over a device mesh (ops with
-    GSPMD-opaque fast paths, e.g. pallas kernels, bail out when set)."""
+    GSPMD-opaque fast paths, e.g. pallas kernels, bail out when set).
+    ``mesh`` carries the executor's Mesh (or None) for ops that place
+    sharding constraints themselves — e.g. sparse MoE dispatch pinning
+    its expert-major tensors to the 'expert' axis."""
 
-    __slots__ = ("is_train", "rng", "mesh_active")
+    __slots__ = ("is_train", "rng", "mesh_active", "mesh")
 
-    def __init__(self, is_train=False, rng=None, mesh_active=False):
+    def __init__(self, is_train=False, rng=None, mesh_active=False,
+                 mesh=None):
         self.is_train = is_train
         self.rng = rng
         self.mesh_active = mesh_active
+        self.mesh = mesh
 
 
 def _default_arg_names(n):

@@ -196,3 +196,105 @@ def test_moe_trains():
             initializer=mx.initializer.Xavier(), num_epoch=10)
     score = dict(mod.score(it, "acc"))
     assert score["accuracy"] >= 0.9, score
+
+
+# ---------------------------------------------------------------------------
+# sparse capacity-based dispatch (capacity_factor > 0)
+# ---------------------------------------------------------------------------
+def test_moe_sparse_matches_dense_at_ample_capacity():
+    """capacity_factor = E guarantees no token drops even if one expert
+    takes everything — sparse output must equal the dense oracle."""
+    from mxnet_tpu.ops.moe import _moe_forward, _moe_forward_sparse
+
+    rng = np.random.RandomState(4)
+    n, d, e, h = 32, 8, 4, 16
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    wg, w1, b1, w2, b2 = _weights(rng, d, e, h)
+    yd, auxd = _moe_forward(x, wg, w1, b1, w2, b2, e)
+    ys, auxs = _moe_forward_sparse(x, wg, w1, b1, w2, b2, e, float(e))
+    assert_almost_equal(np.asarray(ys), np.asarray(yd), rtol=1e-5,
+                        atol=1e-6)
+    assert_almost_equal(np.asarray(auxs), np.asarray(auxd), rtol=1e-5)
+
+
+def test_moe_sparse_drops_overflow_tokens():
+    """Past-capacity tokens emit zeros (Switch semantics: the residual
+    connection carries them)."""
+    from mxnet_tpu.ops.moe import _moe_forward_sparse
+
+    rng = np.random.RandomState(5)
+    n, d, e, h = 32, 8, 4, 16
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    wg, w1, b1, w2, b2 = _weights(rng, d, e, h)
+    # cf=0.5 -> total capacity n/2: at least half the tokens must drop
+    ys, _ = _moe_forward_sparse(x, wg, w1, b1, w2, b2, e, 0.5)
+    zero_rows = int((np.asarray(ys) == 0).all(-1).sum())
+    assert zero_rows >= n // 2, zero_rows
+    # and the kept rows are NOT zero
+    assert zero_rows < n
+
+
+def test_moe_sparse_flops_flat_in_num_experts():
+    """The sparse point: per-step FLOPs must not scale with E (dense pays
+    E times the expert FFN compute)."""
+    import jax
+
+    from mxnet_tpu.ops.moe import _moe_forward, _moe_forward_sparse
+
+    rng = np.random.RandomState(6)
+    n, d, h = 256, 32, 64
+    x = rng.normal(size=(n, d)).astype(np.float32)
+
+    def flops(e, cf):
+        wg, w1, b1, w2, b2 = _weights(rng, d, e, h)
+        if cf:
+            f = jax.jit(lambda *a: _moe_forward_sparse(*a, e, cf)[0])
+        else:
+            f = jax.jit(lambda *a: _moe_forward(*a, e)[0])
+        ca = f.lower(x, wg, w1, b1, w2, b2).compile().cost_analysis()
+        return (ca[0] if isinstance(ca, list) else ca)["flops"]
+
+    s2, s8 = flops(2, 1.5), flops(8, 1.5)
+    d2, d8 = flops(2, 0.0), flops(8, 0.0)
+    assert s8 / s2 < 1.6, (s2, s8)       # router-only growth
+    assert d8 / d2 > 2.5, (d2, d8)       # dense scales with E
+    assert s8 < d8 / 2, (s8, d8)
+
+
+def test_moe_sparse_expert_parallel_all_to_all():
+    """On a (data, expert) mesh the sparse dispatch's expert-major
+    resharding compiles to all-to-all collectives, and the mesh output
+    matches a single device."""
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
+
+    rng = np.random.RandomState(7)
+    n, d, e, h = 64, 16, 4, 32
+    data = sym.Variable("data")
+    net = sym.MoEFFN(data, num_experts=e, hidden_size=h,
+                     capacity_factor=float(e), name="moe")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod1 = mx.mod.Module(net, context=mx.cpu(0))
+    mod1.bind(data_shapes=[("data", (n, d))],
+              label_shapes=[("softmax_label", (n,))])
+    mod1.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    arg_params, aux_params = mod1.get_params()
+
+    modN = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                         mesh_config=MeshConfig(data=2, expert=4))
+    modN.bind(data_shapes=[("data", (n, d))],
+              label_shapes=[("softmax_label", (n,))])
+    modN.init_params(arg_params=arg_params, aux_params=aux_params)
+
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.randint(0, 4, size=(n,)).astype(np.float32)
+    batch = DataBatch([nd.array(x)], [nd.array(y)])
+    mod1.forward(batch, is_train=True)
+    modN.forward(batch, is_train=True)
+    assert_almost_equal(modN.get_outputs()[0].asnumpy(),
+                        mod1.get_outputs()[0].asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+    modN.backward()
+    st = collective_stats(modN._exec_group.exec_.compiled_hlo())
+    assert st.get("all-to-all", {"count": 0})["count"] > 0, st

@@ -6,6 +6,7 @@ model-axis shardings and GSPMD inserts the collectives.  These tests prove
 the (data x model) mesh computes the same numbers as one device.
 """
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import ndarray as nd
@@ -141,28 +142,70 @@ def test_megatron_plan_pairs_column_row():
     assert plan["fc_weight"] == ("model", None)
 
 
-def _step_hlo(mode, monkeypatch):
-    import os
+def _tp_mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    return (net, [("data", (8, 32))], [("softmax_label", (8,))],
+            rng.randn(8, 32).astype(np.float32),
+            rng.randint(0, 4, 8).astype(np.float32))
 
+
+def _tp_attention_lm():
+    """The Megatron headline case: QKV column / out-proj row over heads."""
+    vocab, e, t, b = 17, 64, 8, 4
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=vocab, output_dim=e, name="embed")
+    q = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="q")
+    k = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="k")
+    v = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="v")
+    att = sym.dot_product_attention(q, k, v, num_heads=4, causal=True)
+    out = sym.FullyConnected(att, num_hidden=e, flatten=False, name="proj")
+    net = sym.FullyConnected(out, num_hidden=8, name="head")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(1)
+    return (net, [("data", (b, t))], [("softmax_label", (b,))],
+            rng.randint(0, vocab, (b, t)).astype(np.float32),
+            rng.randint(0, 8, b).astype(np.float32))
+
+
+def _tp_conv_pool_net():
+    """Conv pairs spanning Pooling: the walk must carry 'feat' through."""
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                          name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, global_pool=True, pool_type="avg",
+                      kernel=(1, 1))
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(2)
+    return (net, [("data", (4, 3, 8, 8))], [("softmax_label", (4,))],
+            rng.randn(4, 3, 8, 8).astype(np.float32),
+            rng.randint(0, 4, 4).astype(np.float32))
+
+
+def _step_hlo(mode, monkeypatch, builder=_tp_mlp):
     from mxnet_tpu import config as _config
 
     monkeypatch.setenv("MXNET_TP_MODE", mode)
     _config.refresh("MXNET_TP_MODE")
     try:
-        data = sym.Variable("data")
-        net = sym.FullyConnected(data, num_hidden=64, name="fc1")
-        net = sym.Activation(net, act_type="relu")
-        net = sym.FullyConnected(net, num_hidden=64, name="fc2")
-        net = sym.SoftmaxOutput(net, name="softmax")
+        net, data_shapes, label_shapes, x, y = builder()
         mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)],
                             mesh_config=MeshConfig(data=1, model=2))
-        mod.bind(data_shapes=[("data", (8, 32))],
-                 label_shapes=[("softmax_label", (8,))])
+        mod.bind(data_shapes=data_shapes, label_shapes=label_shapes)
         np.random.seed(3)  # identical params under both plans
         mod.init_params(mx.initializer.Xavier())
-        rng = np.random.RandomState(0)
-        batch = DataBatch([nd.array(rng.randn(8, 32).astype(np.float32))],
-                          [nd.array(rng.randint(0, 4, 8).astype(np.float32))])
+        batch = DataBatch([nd.array(x)], [nd.array(y)])
         mod.forward(batch, is_train=True)
         mod.backward()
         out = mod.get_outputs()[0].asnumpy()
@@ -172,24 +215,53 @@ def _step_hlo(mode, monkeypatch):
     return hlo, out
 
 
-def test_megatron_fewer_collectives_than_naive(monkeypatch):
-    """The round-4 contract: the pairing measurably cuts communication.
+@pytest.mark.parametrize("builder", [_tp_mlp, _tp_attention_lm,
+                                     _tp_conv_pool_net],
+                         ids=["mlp", "attention_lm", "conv_pool"])
+def test_megatron_fewer_collectives_than_naive(monkeypatch, builder):
+    """The round-4 contract: the pairing measurably cuts communication —
+    now asserted where Megatron matters most (round-4 verdict #4), not
+    just on the MLP: the attention LM (QKV column / out-proj row through
+    the head-sharded attention) and a conv net whose pairs span Pooling.
 
     Counted from optimized HLO (parallel/hlo_stats), not asserted from
-    intuition: a 2-layer MLP train step at model=2 must move fewer
+    intuition: each net's train step at model=2 must move fewer
     collectives (and fewer bytes) under the megatron plan than under
     blanket dim-0 sharding.
     """
     from mxnet_tpu.parallel.hlo_stats import collective_stats
 
-    hlo_m, out_m = _step_hlo("megatron", monkeypatch)
-    hlo_n, out_n = _step_hlo("naive", monkeypatch)
-    np.testing.assert_allclose(out_m, out_n, rtol=1e-5, atol=1e-6)
+    hlo_m, out_m = _step_hlo("megatron", monkeypatch, builder)
+    hlo_n, out_n = _step_hlo("naive", monkeypatch, builder)
+    np.testing.assert_allclose(out_m, out_n, rtol=1e-4, atol=1e-5)
 
     st_m = collective_stats(hlo_m)
     st_n = collective_stats(hlo_n)
     assert st_m["total"]["count"] < st_n["total"]["count"], (st_m, st_n)
     assert st_m["total"]["bytes"] < st_n["total"]["bytes"], (st_m, st_n)
+
+
+def test_megatron_plan_attention_and_pooling_rules():
+    """The walk's new rules produce the Megatron attention pattern —
+    vocab-sharded Embedding, COLUMN q/k/v over heads, 'feat' carried
+    through the attention op, ROW out-projection — and Pooling preserves
+    channel sharding so conv pairs span it."""
+    from mxnet_tpu.parallel.tp_rules import plan_tensor_parallel
+
+    net = _tp_attention_lm()[0]
+    plan = plan_tensor_parallel(net)
+    assert plan["embed_weight"] == ("model", None)     # vocab-parallel
+    for name in ("q_weight", "k_weight", "v_weight"):
+        assert plan[name] == ("model", None), name     # column over heads
+    assert plan["proj_weight"] == (None, "model")      # row: the ONE psum
+    assert "proj_bias" not in plan                     # added post-psum
+
+    net2 = _tp_conv_pool_net()[0]
+    plan2 = plan_tensor_parallel(net2)
+    assert plan2["conv1_weight"] == ("model", None, None, None)
+    # pooling carried 'feat' through: conv2 is ROW-parallel (the pair's
+    # psum), not a fresh column start
+    assert plan2["conv2_weight"] == (None, "model", None, None)
 
 
 def test_tp_survives_reshape():

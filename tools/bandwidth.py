@@ -30,13 +30,56 @@ def _time(fn, *args, iters=5):
     return (time.perf_counter() - t0) / iters
 
 
+def _mlp_case(sym):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=256, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=256, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    return net, [("data", (16, 64))], [("softmax_label", (16,))], \
+        (16, 64), 4
+
+
+def _attention_lm_case(sym):
+    vocab, e, t, b = 1024, 256, 32, 8
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=vocab, output_dim=e, name="embed")
+    q = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="q")
+    k = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="k")
+    v = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="v")
+    att = sym.dot_product_attention(q, k, v, num_heads=8, causal=True)
+    out = sym.FullyConnected(att, num_hidden=e, flatten=False, name="proj")
+    net = sym.FullyConnected(out, num_hidden=64, name="head")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    return net, [("data", (b, t))], [("softmax_label", (b,))], (b, t), 64
+
+
+def _conv_pool_case(sym):
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                          name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, global_pool=True, pool_type="avg",
+                      kernel=(1, 1))
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=8, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    return net, [("data", (8, 3, 16, 16))], [("softmax_label", (8,))], \
+        (8, 3, 16, 16), 8
+
+
 def model_step_report(n_model):
     """Static comm accounting for one tensor-parallel training step.
 
-    Compiles a 2-layer-MLP train step at model=n_model under both TP plans
-    (megatron pairing vs naive dim-0) and prints collective count + payload
-    bytes from the optimized HLO — the XLA-era version of the reference's
-    per-batch push/pull cost table.
+    Compiles train steps at model=n_model under both TP plans (megatron
+    pairing vs naive dim-0) — a 2-layer MLP, an attention LM (QKV column /
+    out-proj row over heads), and a conv+pooling net — and prints
+    collective count + payload bytes from the optimized HLO: the XLA-era
+    version of the reference's per-batch push/pull cost table.
     """
     import numpy as np
 
@@ -48,22 +91,21 @@ def model_step_report(n_model):
     from mxnet_tpu.parallel import MeshConfig
     from mxnet_tpu.parallel.hlo_stats import collective_stats
 
-    def step_stats(mode):
+    def step_stats(case, mode):
         os.environ["MXNET_TP_MODE"] = mode
         _config.refresh("MXNET_TP_MODE")
-        data = sym.Variable("data")
-        net = sym.FullyConnected(data, num_hidden=256, name="fc1")
-        net = sym.Activation(net, act_type="relu")
-        net = sym.FullyConnected(net, num_hidden=256, name="fc2")
-        net = sym.SoftmaxOutput(net, name="softmax")
+        net, data_shapes, label_shapes, data_shape, classes = case(sym)
         mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(n_model)],
                             mesh_config=MeshConfig(data=1, model=n_model))
-        mod.bind(data_shapes=[("data", (16, 64))],
-                 label_shapes=[("softmax_label", (16,))])
+        mod.bind(data_shapes=data_shapes, label_shapes=label_shapes)
         mod.init_params(mx.initializer.Xavier())
         rng = np.random.RandomState(0)
-        batch = DataBatch([nd.array(rng.randn(16, 64).astype(np.float32))],
-                          [nd.array(rng.randint(0, 4, 16).astype(np.float32))])
+        if case is _attention_lm_case:
+            x = rng.randint(0, 1024, data_shape).astype(np.float32)
+        else:
+            x = rng.randn(*data_shape).astype(np.float32)
+        y = rng.randint(0, classes, data_shape[0]).astype(np.float32)
+        batch = DataBatch([nd.array(x)], [nd.array(y)])
         mod.forward(batch, is_train=True)
         mod.backward()
         hlo = mod._exec_group.exec_.compiled_hlo()
@@ -73,15 +115,18 @@ def model_step_report(n_model):
                              " account; unset the eager knobs and retry")
         return collective_stats(hlo)
 
-    for mode in ("megatron", "naive"):
-        st = step_stats(mode)
-        print("TP plan %-9s: %3d collectives, %8.1f KiB/step moved" %
-              (mode, st["total"]["count"], st["total"]["bytes"] / 1024),
-              flush=True)
-        for op, e in sorted(st.items()):
-            if op != "total":
-                print("    %-19s x%-3d %8.1f KiB" %
-                      (op, e["count"], e["bytes"] / 1024), flush=True)
+    for case, label in ((_mlp_case, "mlp"),
+                        (_attention_lm_case, "attention_lm"),
+                        (_conv_pool_case, "conv_pool")):
+        for mode in ("megatron", "naive"):
+            st = step_stats(case, mode)
+            print("%-13s TP plan %-9s: %3d collectives, %8.1f KiB/step "
+                  "moved" % (label, mode, st["total"]["count"],
+                             st["total"]["bytes"] / 1024), flush=True)
+            for op, e in sorted(st.items()):
+                if op != "total":
+                    print("    %-19s x%-3d %8.1f KiB" %
+                          (op, e["count"], e["bytes"] / 1024), flush=True)
 
 
 def main():
