@@ -63,33 +63,59 @@ def conv_fn(layout, stride, pad):
     return f
 
 
-def timed_loop(op, args, iters, reps=3):
-    """min-of-reps time of `op` applied `iters` times inside one jit.
+MIN_ROTATE_BYTES = 256 << 20     # defeat VMEM residency (v5e VMEM 128MB)
 
-    The first argument gets an ADDITIVE per-iteration perturbation: it is
-    loop-variant (no LICM hoist) and — unlike a scalar multiply, which
-    XLA's algebraic simplifier commutes through the linear conv, hoisting
-    the conv itself — an additive shift cannot be folded away (splitting
-    conv(x+c) doubles the convs; no simplifier does it), while the add
-    fuses into the conv fusion's input read, costing ~nothing."""
 
-    def body(x0, rest):
-        def step(i, acc):
-            out = op(x0 + (1e-6 * i.astype(jnp.float32)).astype(x0.dtype),
-                     *rest)
-            return acc + out.astype(jnp.float32).sum()
-        return lax.fori_loop(0, iters, step, jnp.float32(0.0))
+def timed_loop(op, args, iters=96, base_iters=16, reps=3):
+    """Per-op time of `op` inside one jit, measured DIFFERENTIALLY.
 
-    f = jax.jit(body)
-    r = f(args[0], args[1:])
-    r.block_until_ready()
+    Methodology (each piece is load-bearing on this rig):
+      * The operands rotate through R copies sized past VMEM, indexed
+        i % R with a dynamic slice that fuses into the consumer's read —
+        otherwise XLA's memory-space assignment pins a single operand in
+        VMEM for the whole loop and reports VMEM-fed throughput the real
+        model never sees.
+      * The first operand also gets an additive per-iteration shift: a
+        scalar MULTIPLY would commute through the linear conv and hoist
+        it out of the loop entirely (measured: 10000+ "TF/s").
+      * The reported time is (T(iters) - T(base_iters)) / (iters - base),
+      	which cancels the tunnel's 50-150ms jittering round-trip
+        constant; a plain T/iters is noise at these op sizes.
+      * float() readback is the sync — block_until_ready has been
+        observed returning early through the tunnel.
+    """
+    total = sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in args)
+    r_copies = max(2, int(np.ceil(MIN_ROTATE_BYTES / max(total, 1))))
+    r_copies = min(r_copies, 8)
+    big = [jnp.stack([a + jnp.asarray(k * 1e-6, a.dtype)
+                      for k in range(r_copies)]) for a in args]
+
+    def make(n_iters):
+        def body(*ops):
+            def step(i, acc):
+                idx = lax.rem(i, r_copies)
+                sel = [lax.dynamic_index_in_dim(o, idx, 0, keepdims=False)
+                       for o in ops]
+                x0 = sel[0] + (1e-6 * i.astype(jnp.float32)) \
+                    .astype(sel[0].dtype)
+                out = op(x0, *sel[1:])
+                return acc + out.astype(jnp.float32).sum()
+            return lax.fori_loop(0, n_iters, step, jnp.float32(0.0))
+        return jax.jit(body)
+
+    f_hi, f_lo = make(iters), make(base_iters)
+    float(f_hi(*big))
+    float(f_lo(*big))
     best = np.inf
     for _ in range(reps):
         t0 = time.perf_counter()
-        r = f(args[0], args[1:])
-        r.block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    return best / iters
+        float(f_lo(*big))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(f_hi(*big))
+        t_hi = time.perf_counter() - t0
+        best = min(best, (t_hi - t_lo) / (iters - base_iters))
+    return max(best, 1e-9)
 
 
 def flops_of(cin, cout, k, stride, hin):
@@ -98,7 +124,7 @@ def flops_of(cin, cout, k, stride, hin):
 
 
 def bench_shape(name, cin, cout, k, stride, hin, layout="NCHW",
-                dtype=jnp.bfloat16, iters=8):
+                dtype=jnp.bfloat16):
     rng = np.random.RandomState(0)
     pad = k // 2
     hout = (hin + 2 * pad - k) // stride + 1
@@ -114,7 +140,7 @@ def bench_shape(name, cin, cout, k, stride, hin, layout="NCHW",
     f = conv_fn(layout, stride, pad)
     fl = flops_of(cin, cout, k, stride, hin)
 
-    t_fwd = timed_loop(lambda x_, w_: f(x_, w_), (x, w), iters)
+    t_fwd = timed_loop(lambda x_, w_: f(x_, w_), (x, w))
 
     def dgrad(dy_, x_, w_):
         _, vjp = jax.vjp(lambda xx: f(xx, w_), x_)
@@ -124,8 +150,8 @@ def bench_shape(name, cin, cout, k, stride, hin, layout="NCHW",
         _, vjp = jax.vjp(lambda ww: f(x_, ww), w_)
         return vjp(dy_)[0]
 
-    t_dg = timed_loop(dgrad, (dy, x, w), iters)
-    t_wg = timed_loop(wgrad, (dy, x, w), iters)
+    t_dg = timed_loop(dgrad, (dy, x, w))
+    t_wg = timed_loop(wgrad, (dy, x, w))
     return fl, t_fwd, t_dg, t_wg
 
 

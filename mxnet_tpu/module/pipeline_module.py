@@ -12,13 +12,25 @@ runs embed -> GPipe fill-drain schedule over the 'pipe' mesh axis ->
 head, with the optimizer update fused in (the reference's
 update-per-batch, as one program).
 
-Pipeline model (the standard homogeneous-stage primitive):
+Pipeline model:
 
 * ``stage_symbol`` — ONE stage's computation, input variable ``data``,
   single output of the same shape (e.g. an LSTM/transformer block).  The
   module stacks its parameters ``num_stages`` times with a leading stage
   axis sharded on 'pipe' — each device owns one stage's weights, stage s
-  applies slice s.
+  applies slice s.  HETEROGENEOUS stages: pass a LIST of per-stage
+  symbols instead — they must share the same graph structure and
+  parameter names, but internal widths may differ per stage (the
+  reference pipelines arbitrary group2ctx graphs; here different-width
+  stages cover the common case).  Each parameter is zero-padded to the
+  max shape across stages before stacking; the padding is EXACT — padded
+  weight columns/rows are zero, so padded activation lanes contribute
+  nothing through the next projection and receive zero gradients —
+  provided the ops between a stage's projections are lane-local
+  (Activation, Dropout, adds...).  A feature-reducing op inside the
+  padded region (LayerNorm over the hidden dim) would see the zero lanes;
+  bind rejects stages whose structures differ, but lane-locality is the
+  caller's contract.
 * ``embed_symbol`` (optional) — maps the raw batch to the stage
   activation shape (e.g. Embedding); runs data-parallel before the pipe.
 * ``head_symbol`` — consumes the pipeline output (input ``data``) plus
@@ -91,11 +103,42 @@ def _symbol_fn(symbol):
     return fn
 
 
+# attrs that set a layer's WIDTH — the one thing heterogeneous stages are
+# allowed to vary; everything else (op kinds, activation types, wiring)
+# must match because execution traces stage 0's graph for all stages
+_WIDTH_ATTRS = frozenset(["num_hidden", "num_filter", "hidden_size"])
+
+
+def _stage_structure_signature(symbol):
+    """Hashable (op, non-width attrs, wiring) sequence of a stage graph."""
+    nodes = list(symbol._topo())
+    index = {id(n): i for i, n in enumerate(nodes)}
+    sig = []
+    for n in nodes:
+        if n.is_variable:
+            sig.append(("var", n.name))
+            continue
+        attrs = {k: v for k, v in sorted(n.parsed_attrs().items())
+                 if k not in _WIDTH_ATTRS}
+        wiring = tuple((index[id(s)], i) for s, i in n.inputs)
+        sig.append((n.op.name, tuple(attrs.items()), wiring))
+    return tuple(sig)
+
+
 class PipelineModule(BaseModule):
     def __init__(self, stage_symbol, head_symbol, num_stages,
                  num_microbatches, embed_symbol=None, context=None,
                  logger=logging):
         super().__init__(logger=logger)
+        if isinstance(stage_symbol, (list, tuple)):
+            if len(stage_symbol) != int(num_stages):
+                raise MXNetError(
+                    "heterogeneous pipeline: %d stage symbols for "
+                    "num_stages=%d" % (len(stage_symbol), num_stages))
+            self._stage_syms = list(stage_symbol)
+            stage_symbol = self._stage_syms[0]
+        else:
+            self._stage_syms = None      # homogeneous: one symbol stacked
         self._stage_sym = stage_symbol
         self._head_sym = head_symbol
         self._embed_sym = embed_symbol
@@ -177,14 +220,53 @@ class PipelineModule(BaseModule):
         else:
             act_shape = (mb,) + in_shape[1:]
             self._embed_shapes = {}
-        sargs, souts, _ = self._stage_sym.infer_shape(data=act_shape)
-        if tuple(souts[0]) != tuple(act_shape):
-            raise MXNetError("stage must preserve the activation shape "
-                             "(got %s from %s)" % (souts[0], act_shape))
         self._act_shape = tuple(act_shape)
-        self._stage_shapes = dict(zip(self._stage_sym.list_arguments(),
-                                      sargs))
-        self._stage_shapes.pop("data")
+        if self._stage_syms is None:
+            sargs, souts, _ = self._stage_sym.infer_shape(data=act_shape)
+            if tuple(souts[0]) != tuple(act_shape):
+                raise MXNetError("stage must preserve the activation shape "
+                                 "(got %s from %s)" % (souts[0], act_shape))
+            self._stage_shapes = dict(zip(self._stage_sym.list_arguments(),
+                                          sargs))
+            self._stage_shapes.pop("data")
+            self._stage_true_shapes = None
+        else:
+            # heterogeneous: same structure/arg names required; params pad
+            # to the per-name max shape across stages
+            names0 = self._stage_syms[0].list_arguments()
+            sig0 = _stage_structure_signature(self._stage_syms[0])
+            per_stage = []
+            for k, s in enumerate(self._stage_syms):
+                if s.list_arguments() != names0:
+                    raise MXNetError(
+                        "heterogeneous pipeline stages must share parameter"
+                        " structure: stage %d has args %s, stage 0 has %s"
+                        % (k, s.list_arguments(), names0))
+                sig = _stage_structure_signature(s)
+                if sig != sig0:
+                    raise MXNetError(
+                        "heterogeneous pipeline stages must share graph "
+                        "STRUCTURE (ops, attrs, wiring) — only widths may "
+                        "differ; stage %d diverges from stage 0:\n  %s\n"
+                        "  vs\n  %s" % (k, sig, sig0))
+                sargs, souts, _ = s.infer_shape(data=act_shape)
+                if tuple(souts[0]) != tuple(act_shape):
+                    raise MXNetError(
+                        "stage %d must preserve the activation shape "
+                        "(got %s from %s)" % (k, souts[0], act_shape))
+                shapes = dict(zip(names0, sargs))
+                shapes.pop("data")
+                per_stage.append(shapes)
+            self._stage_true_shapes = per_stage
+            self._stage_shapes = {}
+            for name in per_stage[0]:
+                dims = {len(sh[name]) for sh in per_stage}
+                if len(dims) != 1:
+                    raise MXNetError(
+                        "stage param %r rank differs across stages" % name)
+                self._stage_shapes[name] = tuple(
+                    max(sh[name][i] for sh in per_stage)
+                    for i in range(dims.pop()))
 
         head_kwargs = {"data": (batch,) + tuple(act_shape[1:])}
         for d in self._label_shapes:
@@ -256,9 +338,19 @@ class PipelineModule(BaseModule):
         for name, shape in self._stage_shapes.items():
             if arg_params and name in arg_params:
                 stacked = arg_params[name].asnumpy()
-            else:
+            elif self._stage_true_shapes is None:
                 stacked = np.stack([make(name, shape)
                                     for _ in range(self._num_stages)])
+            else:
+                # heterogeneous: initialize each stage at its TRUE shape
+                # inside a zero block — the zero padding is what makes the
+                # max-shape stacking exact (see module docstring)
+                stacked = np.zeros((self._num_stages,) + tuple(shape),
+                                   np.float32)
+                for k, true in enumerate(self._stage_true_shapes):
+                    block = make(name, true[name])
+                    idx = (k,) + tuple(slice(0, d) for d in true[name])
+                    stacked[idx] = block
             params[name] = jax.device_put(stacked.astype(np.float32),
                                           self._stage_sharding[name])
         for shapes in (self._embed_shapes, self._head_shapes):

@@ -312,3 +312,134 @@ def test_pipeline_composes_with_data_axis():
     ref = np.asarray(_sequential(per_stage, x.reshape(-1, d))) \
         .reshape(micro, mb, d)
     assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def _stage_sym_width(h, d):
+    from mxnet_tpu import symbol as sym
+
+    s = sym.FullyConnected(sym.Variable("data"), num_hidden=h,
+                           name="fc_in")
+    s = sym.Activation(s, act_type="tanh")
+    s = sym.FullyConnected(s, num_hidden=d, name="fc_out")
+    return s
+
+
+def test_pipeline_heterogeneous_matches_unrolled():
+    """A pipeline of DIFFERENT-width stages (round-4 verdict #5) computes
+    the same numbers as the unrolled single-device net: per-stage params
+    zero-pad to the max width, which is exact for lane-local interiors."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.io import DataBatch
+
+    d, batch = 8, 8
+    widths = [4, 16, 8, 12]
+    rng = np.random.RandomState(0)
+
+    net = sym.Variable("data")
+    for s_i, h in enumerate(widths):
+        net = sym.FullyConnected(net, num_hidden=h, name="fc_in%d" % s_i)
+        net = sym.Activation(net, act_type="tanh")
+        net = sym.FullyConnected(net, num_hidden=d, name="fc_out%d" % s_i)
+    net = sym.FullyConnected(net, num_hidden=3, name="out")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    ref = mx.mod.Module(net, context=mx.cpu(0))
+    ref.bind(data_shapes=[("data", (batch, d))],
+             label_shapes=[("softmax_label", (batch,))])
+    ref.init_params(mx.initializer.Xavier())
+    arg_params, _ = ref.get_params()
+
+    stages = [_stage_sym_width(h, d) for h in widths]
+    pipe = mx.mod.PipelineModule(
+        stages, _head_sym(3), num_stages=len(widths), num_microbatches=4,
+        context=[mx.cpu(i) for i in range(8)])
+    pipe.bind(data_shapes=[("data", (batch, d))],
+              label_shapes=[("softmax_label", (batch,))])
+    hmax = max(widths)
+    w_in = np.zeros((len(widths), hmax, d), np.float32)
+    b_in = np.zeros((len(widths), hmax), np.float32)
+    w_out = np.zeros((len(widths), d, hmax), np.float32)
+    b_out = np.zeros((len(widths), d), np.float32)
+    for s_i, h in enumerate(widths):
+        w_in[s_i, :h] = arg_params["fc_in%d_weight" % s_i].asnumpy()
+        b_in[s_i, :h] = arg_params["fc_in%d_bias" % s_i].asnumpy()
+        w_out[s_i, :, :h] = arg_params["fc_out%d_weight" % s_i].asnumpy()
+        b_out[s_i] = arg_params["fc_out%d_bias" % s_i].asnumpy()
+    pipe.init_params(arg_params={
+        "fc_in_weight": nd.array(w_in), "fc_in_bias": nd.array(b_in),
+        "fc_out_weight": nd.array(w_out), "fc_out_bias": nd.array(b_out),
+        "out_weight": arg_params["out_weight"],
+        "out_bias": arg_params["out_bias"]})
+
+    X = rng.randn(batch, d).astype(np.float32)
+    batch_data = DataBatch([nd.array(X)], [])
+    ref.forward(batch_data, is_train=False)
+    pipe.forward(batch_data, is_train=False)
+    assert_almost_equal(ref.get_outputs()[0].asnumpy(),
+                        pipe.get_outputs()[0].asnumpy(),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_heterogeneous_fit_converges():
+    """Different-width stages train end-to-end through Module.fit on the
+    (pipe, data) mesh."""
+    from mxnet_tpu.io import NDArrayIter
+
+    d, classes = 8, 2
+    widths = [16, 4, 8, 12]
+    rng = np.random.RandomState(11)
+    n = 64
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    stages = [_stage_sym_width(h, d) for h in widths]
+    pipe = mx.mod.PipelineModule(
+        stages, _head_sym(classes), num_stages=len(widths),
+        num_microbatches=4, context=[mx.cpu(i) for i in range(8)])
+    it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=16)
+    np.random.seed(13)
+    pipe.fit(it, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+             initializer=mx.initializer.Xavier(), num_epoch=60,
+             eval_metric="acc")
+    it.reset()
+    score = dict(pipe.score(it, "acc"))
+    assert score["accuracy"] > 0.9, score
+    # the zero padding survived training: stage 0's fc_in rows past its
+    # true width must still be zero
+    params, _ = pipe.get_params()
+    w = params["fc_in_weight"].asnumpy()
+    for s_i, h in enumerate(widths):
+        np.testing.assert_array_equal(w[s_i, h:], 0.0)
+
+
+def test_pipeline_heterogeneous_rejects_mismatched_structure():
+    from mxnet_tpu import symbol as sym
+
+    s0 = _stage_sym_width(4, 8)
+    s1 = sym.Activation(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=8, name="other"), act_type="tanh")
+    with pytest.raises(mx.base.MXNetError):
+        mx.mod.PipelineModule(
+            [s0, s1], _head_sym(2), num_stages=2, num_microbatches=2,
+            context=[mx.cpu(i) for i in range(4)]) \
+            .bind(data_shapes=[("data", (8, 8))])
+
+
+def test_pipeline_heterogeneous_rejects_different_ops():
+    """Same param names but different ops/attrs (tanh vs relu) must be
+    rejected at bind — execution traces stage 0's graph for all stages,
+    so a structural mismatch would silently compute the wrong function."""
+    from mxnet_tpu import symbol as sym
+
+    def stage(act):
+        s = sym.FullyConnected(sym.Variable("data"), num_hidden=4,
+                               name="fc_in")
+        s = sym.Activation(s, act_type=act)
+        return sym.FullyConnected(s, num_hidden=8, name="fc_out")
+
+    with pytest.raises(mx.base.MXNetError, match="STRUCTURE"):
+        mx.mod.PipelineModule(
+            [stage("tanh"), stage("relu"), stage("tanh"), stage("relu")],
+            _head_sym(2), num_stages=4, num_microbatches=2,
+            context=[mx.cpu(i) for i in range(8)]) \
+            .bind(data_shapes=[("data", (8, 8))])
