@@ -12,6 +12,10 @@ CAXIS = 1 if LAYOUT == "NCHW" else 3
 DN = ("NCHW", "OIHW", "NCHW") if LAYOUT == "NCHW" else ("NHWC", "HWIO", "NHWC")
 
 S2D = os.environ.get("S2D", "0") == "1"  # space-to-depth conv0 (MLPerf trick)
+# pad conv0's input channels 3 -> PAD0 with zeros (weights for the pad
+# channels are zero and see zero inputs, so the math is exact); isolated
+# per-shape timing says the 3-channel conv0 underfills the MXU
+PAD0 = int(os.environ.get("PAD0", "0"))
 
 rng = np.random.RandomState(0)
 params = {}
@@ -74,6 +78,8 @@ FILTERS = [256, 512, 1024, 2048]
 # build params
 if S2D:
     conv_w("conv0", 12, 64, 4)  # 2x2 space-to-depth: 224x224x3 -> 112x112x12
+elif PAD0:
+    conv_w("conv0", PAD0, 64, 7)
 else:
     conv_w("conv0", 3, 64, 7)
 bn_w("bn0", 64)
@@ -229,12 +235,17 @@ def train(p, mom, x, y):
 
 
 mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+cin0 = PAD0 if PAD0 else 3
 if LAYOUT == "NCHW":
-    x = jnp.asarray(rng.rand(N, 3, 224, 224), jnp.bfloat16)
+    x = np.zeros((N, cin0, 224, 224), np.float32)
+    x[:, :3] = rng.rand(N, 3, 224, 224)
+    x = jnp.asarray(x, jnp.bfloat16)
 elif S2D:
     x = jnp.asarray(rng.rand(N, 112, 112, 12), jnp.bfloat16)
 else:
-    x = jnp.asarray(rng.rand(N, 224, 224, 3), jnp.bfloat16)
+    x = np.zeros((N, 224, 224, cin0), np.float32)
+    x[..., :3] = rng.rand(N, 224, 224, 3)
+    x = jnp.asarray(x, jnp.bfloat16)
 y = jnp.asarray(rng.randint(0, 1000, (N,)), jnp.int32)
 
 f = jax.jit(train, donate_argnums=(0, 1))

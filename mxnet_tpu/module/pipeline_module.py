@@ -26,11 +26,13 @@ Pipeline model:
   max shape across stages before stacking; the padding is EXACT — padded
   weight columns/rows are zero, so padded activation lanes contribute
   nothing through the next projection and receive zero gradients —
-  provided the ops between a stage's projections are lane-local
-  (Activation, Dropout, adds...).  A feature-reducing op inside the
-  padded region (LayerNorm over the hidden dim) would see the zero lanes;
-  bind rejects stages whose structures differ, but lane-locality is the
-  caller's contract.
+  provided the ops between a stage's projections are lane-local AND
+  zero-preserving (relu/tanh/softsign activations, Dropout, adds —
+  sigmoid maps the padded zeros to 0.5, which the optimizer then turns
+  into live phantom lanes).  bind rejects stages whose structures differ
+  and non-zero-preserving Activation types; a feature-reducing op inside
+  the padded region (LayerNorm over the hidden dim) remains the caller's
+  contract to avoid.
 * ``embed_symbol`` (optional) — maps the raw batch to the stage
   activation shape (e.g. Embedding); runs data-parallel before the pipe.
 * ``head_symbol`` — consumes the pipeline output (input ``data``) plus
@@ -249,6 +251,16 @@ class PipelineModule(BaseModule):
                         "STRUCTURE (ops, attrs, wiring) — only widths may "
                         "differ; stage %d diverges from stage 0:\n  %s\n"
                         "  vs\n  %s" % (k, sig, sig0))
+                for node in s._topo():
+                    if node.is_variable or node.op.name != "Activation":
+                        continue
+                    act = node.parsed_attrs().get("act_type", "relu")
+                    if act not in ("relu", "tanh", "softsign"):
+                        raise MXNetError(
+                            "heterogeneous pipeline stages need "
+                            "zero-preserving activations (f(0)=0: relu/"
+                            "tanh/softsign); %r would turn the zero "
+                            "padding into live lanes" % act)
                 sargs, souts, _ = s.infer_shape(data=act_shape)
                 if tuple(souts[0]) != tuple(act_shape):
                     raise MXNetError(
@@ -338,6 +350,20 @@ class PipelineModule(BaseModule):
         for name, shape in self._stage_shapes.items():
             if arg_params and name in arg_params:
                 stacked = arg_params[name].asnumpy()
+                if self._stage_true_shapes is not None:
+                    # the exactness of max-width stacking rests on zero
+                    # padding; reject caller-supplied params that violate
+                    # it instead of silently computing a different net
+                    for k, true in enumerate(self._stage_true_shapes):
+                        block = stacked[k].copy()
+                        block[tuple(slice(0, d) for d in true[name])] = 0
+                        if np.any(block):
+                            raise MXNetError(
+                                "heterogeneous pipeline param %r stage %d "
+                                "has nonzero values outside its true "
+                                "shape %s — the zero-padding invariant "
+                                "would be violated"
+                                % (name, k, true[name]))
             elif self._stage_true_shapes is None:
                 stacked = np.stack([make(name, shape)
                                     for _ in range(self._num_stages)])

@@ -443,3 +443,33 @@ def test_pipeline_heterogeneous_rejects_different_ops():
             _head_sym(2), num_stages=4, num_microbatches=2,
             context=[mx.cpu(i) for i in range(8)]) \
             .bind(data_shapes=[("data", (8, 8))])
+
+
+def test_pipeline_heterogeneous_rejects_nonzero_padding_and_sigmoid():
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu import ndarray as nd
+
+    # sigmoid stage: f(0)=0.5 would animate the padded lanes
+    def stage(act, h):
+        s = sym.FullyConnected(sym.Variable("data"), num_hidden=h,
+                               name="fc_in")
+        s = sym.Activation(s, act_type=act)
+        return sym.FullyConnected(s, num_hidden=8, name="fc_out")
+
+    with pytest.raises(mx.base.MXNetError, match="zero-preserving"):
+        mx.mod.PipelineModule(
+            [stage("sigmoid", 4), stage("sigmoid", 6)], _head_sym(2),
+            num_stages=2, num_microbatches=2,
+            context=[mx.cpu(i) for i in range(4)]) \
+            .bind(data_shapes=[("data", (8, 8))])
+
+    # caller-supplied stacked params with nonzero padding are rejected
+    pipe = mx.mod.PipelineModule(
+        [stage("tanh", 4), stage("tanh", 6)], _head_sym(2),
+        num_stages=2, num_microbatches=2,
+        context=[mx.cpu(i) for i in range(4)])
+    pipe.bind(data_shapes=[("data", (8, 8))],
+              label_shapes=[("softmax_label", (8,))])
+    bad = np.ones((2, 6, 8), np.float32)   # stage 0 true shape is (4, 8)
+    with pytest.raises(mx.base.MXNetError, match="zero-padding"):
+        pipe.init_params(arg_params={"fc_in_weight": nd.array(bad)})
