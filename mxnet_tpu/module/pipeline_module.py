@@ -251,16 +251,6 @@ class PipelineModule(BaseModule):
                         "STRUCTURE (ops, attrs, wiring) — only widths may "
                         "differ; stage %d diverges from stage 0:\n  %s\n"
                         "  vs\n  %s" % (k, sig, sig0))
-                for node in s._topo():
-                    if node.is_variable or node.op.name != "Activation":
-                        continue
-                    act = node.parsed_attrs().get("act_type", "relu")
-                    if act not in ("relu", "tanh", "softsign"):
-                        raise MXNetError(
-                            "heterogeneous pipeline stages need "
-                            "zero-preserving activations (f(0)=0: relu/"
-                            "tanh/softsign); %r would turn the zero "
-                            "padding into live lanes" % act)
                 sargs, souts, _ = s.infer_shape(data=act_shape)
                 if tuple(souts[0]) != tuple(act_shape):
                     raise MXNetError(
@@ -279,6 +269,24 @@ class PipelineModule(BaseModule):
                 self._stage_shapes[name] = tuple(
                     max(sh[name][i] for sh in per_stage)
                     for i in range(dims.pop()))
+            # the zero-preserving-activation constraint only binds for
+            # stages that actually carry padded lanes (a same-width list,
+            # or the widest stage of a mixed one, has none)
+            for k, s in enumerate(self._stage_syms):
+                padded = any(tuple(per_stage[k][n]) != self._stage_shapes[n]
+                             for n in per_stage[k])
+                if not padded:
+                    continue
+                for node in s._topo():
+                    if node.is_variable or node.op.name != "Activation":
+                        continue
+                    act = node.parsed_attrs().get("act_type", "relu")
+                    if act not in ("relu", "tanh", "softsign"):
+                        raise MXNetError(
+                            "heterogeneous pipeline stage %d is width-"
+                            "padded and needs zero-preserving activations"
+                            " (f(0)=0: relu/tanh/softsign); %r would turn"
+                            " the zero padding into live lanes" % (k, act))
 
         head_kwargs = {"data": (batch,) + tuple(act_shape[1:])}
         for d in self._label_shapes:
@@ -350,20 +358,7 @@ class PipelineModule(BaseModule):
         for name, shape in self._stage_shapes.items():
             if arg_params and name in arg_params:
                 stacked = arg_params[name].asnumpy()
-                if self._stage_true_shapes is not None:
-                    # the exactness of max-width stacking rests on zero
-                    # padding; reject caller-supplied params that violate
-                    # it instead of silently computing a different net
-                    for k, true in enumerate(self._stage_true_shapes):
-                        block = stacked[k].copy()
-                        block[tuple(slice(0, d) for d in true[name])] = 0
-                        if np.any(block):
-                            raise MXNetError(
-                                "heterogeneous pipeline param %r stage %d "
-                                "has nonzero values outside its true "
-                                "shape %s — the zero-padding invariant "
-                                "would be violated"
-                                % (name, k, true[name]))
+                self._check_padding_invariant(name, stacked)
             elif self._stage_true_shapes is None:
                 stacked = np.stack([make(name, shape)
                                     for _ in range(self._num_stages)])
@@ -390,6 +385,23 @@ class PipelineModule(BaseModule):
         self._params = params
         self.params_initialized = True
 
+    def _check_padding_invariant(self, name, stacked):
+        """Heterogeneous stacking is exact ONLY with zero padding; reject
+        caller-supplied stage params (init_params AND set_params /
+        checkpoint loads) that violate it instead of silently computing a
+        different network."""
+        if self._stage_true_shapes is None or \
+                name not in self._stage_shapes:
+            return
+        for k, true in enumerate(self._stage_true_shapes):
+            block = np.array(stacked[k], copy=True)
+            block[tuple(slice(0, d) for d in true[name])] = 0
+            if np.any(block):
+                raise MXNetError(
+                    "heterogeneous pipeline param %r stage %d has nonzero "
+                    "values outside its true shape %s — the zero-padding "
+                    "invariant would be violated" % (name, k, true[name]))
+
     def get_params(self):
         return ({n: nd.array(np.asarray(v)) for n, v in self._params.items()},
                 {})
@@ -403,10 +415,11 @@ class PipelineModule(BaseModule):
                 if not allow_extra:
                     raise MXNetError("unknown param %r" % n)
                 continue
+            host = v.asnumpy().astype(np.float32)
+            self._check_padding_invariant(n, host)
             sh = (self._stage_sharding[n] if n in self._stage_shapes
                   else self._rep_sharding)
-            self._params[n] = jax.device_put(
-                v.asnumpy().astype(np.float32), sh)
+            self._params[n] = jax.device_put(host, sh)
         self.params_initialized = True
 
     # ------------------------------------------------------------------

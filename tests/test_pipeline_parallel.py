@@ -473,3 +473,39 @@ def test_pipeline_heterogeneous_rejects_nonzero_padding_and_sigmoid():
     bad = np.ones((2, 6, 8), np.float32)   # stage 0 true shape is (4, 8)
     with pytest.raises(mx.base.MXNetError, match="zero-padding"):
         pipe.init_params(arg_params={"fc_in_weight": nd.array(bad)})
+
+
+def test_pipeline_heterogeneous_set_params_checks_padding():
+    """set_params (the checkpoint-load path) enforces the same zero-
+    padding invariant as init_params, and same-width stage lists may use
+    any activation (no padded lanes to protect)."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu import ndarray as nd
+
+    def stage(act, h):
+        s = sym.FullyConnected(sym.Variable("data"), num_hidden=h,
+                               name="fc_in")
+        s = sym.Activation(s, act_type=act)
+        return sym.FullyConnected(s, num_hidden=8, name="fc_out")
+
+    # same-width sigmoid stages: exact without padding — must bind
+    pipe = mx.mod.PipelineModule(
+        [stage("sigmoid", 6), stage("sigmoid", 6)], _head_sym(2),
+        num_stages=2, num_microbatches=2,
+        context=[mx.cpu(i) for i in range(4)])
+    pipe.bind(data_shapes=[("data", (8, 8))],
+              label_shapes=[("softmax_label", (8,))])
+    pipe.init_params(mx.initializer.Xavier())
+
+    # mixed widths: set_params with dirty padding must raise
+    pipe2 = mx.mod.PipelineModule(
+        [stage("tanh", 4), stage("tanh", 6)], _head_sym(2),
+        num_stages=2, num_microbatches=2,
+        context=[mx.cpu(i) for i in range(4)])
+    pipe2.bind(data_shapes=[("data", (8, 8))],
+               label_shapes=[("softmax_label", (8,))])
+    pipe2.init_params(mx.initializer.Xavier())
+    bad = np.ones((2, 6, 8), np.float32)
+    with pytest.raises(mx.base.MXNetError, match="zero-padding"):
+        pipe2.set_params({"fc_in_weight": nd.array(bad)},
+                         allow_missing=True)
