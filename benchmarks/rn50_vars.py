@@ -88,7 +88,11 @@ def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
     one-pass stats + cond cancellation guard, hand-written backward.
     ``nocond`` drops the guard, ``nocenter`` additionally drops the
     center subtraction, ``autodiff`` keeps the stats formulation but lets
-    XLA derive the backward — cost-isolation knobs."""
+    XLA derive the backward — cost-isolation knobs.  The SGCOND env flag
+    is a separate whole-variant override (centered stats + stop-gradient
+    cond correction + autodiff backward): it takes precedence over
+    nocond/nocenter and is ignored when ``autodiff`` is set — run it only
+    against plain ``bn_custom`` rows."""
 
     def stats(x, center):
         bshape = (1, x.shape[1], 1, 1)
@@ -129,6 +133,34 @@ def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
             inv = jax.lax.rsqrt(var + EPS)
             return apply(x, gamma, beta, mean, inv), mean, var
         return bn_ad
+
+    if SGCOND:
+        # autodiff-safe guard: the cond only contributes a STOP-GRADIENT
+        # value correction, so differentiation never enters the branches
+        # (no giant branch residuals -> no OOM) while the primal value is
+        # still refined on cancellation
+        def bn_sg(x, gamma, beta, center):
+            bshape = (1, x.shape[1], 1, 1)
+            xc = x.astype(jnp.float32) - center.reshape(bshape)
+            mc = jnp.mean(xc, axis=(0, 2, 3))
+            var_fast = jnp.maximum(
+                jnp.mean(jnp.square(xc), axis=(0, 2, 3))
+                - jnp.square(mc), 0.0)
+            mean = mc + center
+            mc2 = jnp.square(mc)
+            bad = jnp.any((var_fast <= 1e-5 * mc2) & (1e-7 * mc2 > EPS))
+
+            def corr(_):
+                m = jax.lax.stop_gradient(mean).reshape(bshape)
+                true = jnp.mean(
+                    jnp.square(x.astype(jnp.float32) - m), axis=(0, 2, 3))
+                return jax.lax.stop_gradient(true - var_fast)
+
+            var = var_fast + jax.lax.cond(
+                bad, corr, lambda _: jnp.zeros_like(var_fast), None)
+            inv = jax.lax.rsqrt(var + EPS)
+            return apply(x, gamma, beta, mean, inv), mean, var
+        return bn_sg
 
     @jax.custom_vjp
     def bn(x, gamma, beta, center):
@@ -181,6 +213,7 @@ def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
 
 
 LEANBWD = os.environ.get("LEANBWD", "0") == "1"
+SGCOND = os.environ.get("SGCOND", "0") == "1"
 
 
 def make_forward(cfg):

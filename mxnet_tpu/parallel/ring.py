@@ -62,6 +62,13 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     precedent (src/operator/cudnn_rnn-inl.h) — and falls back to jnp
     streaming math otherwise.  ``use_flash`` forces the choice;
     ``interpret`` runs the kernels in interpreter mode (CPU tests).
+
+    Measured on-chip (benchmarks/ROOFLINE.md round-5): flash wins fwd at
+    every block size and fwd+bwd from T_local >= 4096 (1.3x), and is the
+    ONLY trainable path at T_local = 8192 (the streaming backward's
+    rematerialized (T_local, T_local) f32 block logits exceed HBM).  At
+    T_local = 2048 streaming trains ~1.2x faster — pass use_flash=False
+    there if training short blocks on a wide mesh.
     """
     import jax
     import jax.numpy as jnp
