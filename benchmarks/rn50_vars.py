@@ -94,7 +94,15 @@ def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
     nocond/nocenter and is ignored when ``autodiff`` is set — run it only
     against plain ``bn_custom`` rows."""
 
-    def stats(x, center):
+    if SGCOND and (nocond or nocenter or autodiff):
+        raise ValueError("SGCOND=1 replaces the whole stats/backward "
+                         "formulation; combining it with nocond/nocenter/"
+                         "autodiff variants would print mislabeled rows")
+
+    def centered_stats(x, center):
+        """Shared one-pass centered moments + cancellation predicate —
+        ONE copy, so sg-cond rows measure the same formulation as the
+        custom-vjp rows."""
         bshape = (1, x.shape[1], 1, 1)
         x32 = x.astype(jnp.float32)
         if nocenter:
@@ -105,11 +113,15 @@ def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
         mc = jnp.mean(xc, axis=(0, 2, 3))
         var_fast = jnp.maximum(jnp.mean(jnp.square(xc), axis=(0, 2, 3))
                                - jnp.square(mc), 0.0)
-        mean = mc + center
-        if nocond:
-            return mean, var_fast
         mc2 = jnp.square(mc)
         bad = jnp.any((var_fast <= 1e-5 * mc2) & (1e-7 * mc2 > EPS))
+        return mc + center, var_fast, bad
+
+    def stats(x, center):
+        bshape = (1, x.shape[1], 1, 1)
+        mean, var_fast, bad = centered_stats(x, center)
+        if nocond:
+            return mean, var_fast
 
         def refine(_):
             m = jax.lax.stop_gradient(mean).reshape(bshape)
@@ -141,14 +153,7 @@ def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
         # still refined on cancellation
         def bn_sg(x, gamma, beta, center):
             bshape = (1, x.shape[1], 1, 1)
-            xc = x.astype(jnp.float32) - center.reshape(bshape)
-            mc = jnp.mean(xc, axis=(0, 2, 3))
-            var_fast = jnp.maximum(
-                jnp.mean(jnp.square(xc), axis=(0, 2, 3))
-                - jnp.square(mc), 0.0)
-            mean = mc + center
-            mc2 = jnp.square(mc)
-            bad = jnp.any((var_fast <= 1e-5 * mc2) & (1e-7 * mc2 > EPS))
+            mean, var_fast, bad = centered_stats(x, center)
 
             def corr(_):
                 m = jax.lax.stop_gradient(mean).reshape(bshape)
