@@ -90,14 +90,16 @@ def _bn_custom_core(nocond=False, nocenter=False, autodiff=False):
     center subtraction, ``autodiff`` keeps the stats formulation but lets
     XLA derive the backward — cost-isolation knobs.  The SGCOND env flag
     is a separate whole-variant override (centered stats + stop-gradient
-    cond correction + autodiff backward): it takes precedence over
-    nocond/nocenter and is ignored when ``autodiff`` is set — run it only
-    against plain ``bn_custom`` rows."""
+    cond correction + autodiff backward); combining it with
+    nocond/nocenter would measure the sg path under those rows' labels,
+    so that combination raises — run SGCOND=1 only against plain
+    ``bn_custom`` rows.  ``autodiff`` takes precedence over SGCOND (its
+    branch returns first) and keeps its own correct label."""
 
-    if SGCOND and (nocond or nocenter or autodiff):
+    if SGCOND and (nocond or nocenter):
         raise ValueError("SGCOND=1 replaces the whole stats/backward "
-                         "formulation; combining it with nocond/nocenter/"
-                         "autodiff variants would print mislabeled rows")
+                         "formulation; combining it with nocond/nocenter "
+                         "variants would print mislabeled rows")
 
     def centered_stats(x, center):
         """Shared one-pass centered moments + cancellation predicate —

@@ -33,7 +33,8 @@ def stack_stage_params(per_stage_params):
         lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
 
-def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches):
+def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches,
+                   remat=False):
     """Run a stage-per-device pipeline; call under ``shard_map``.
 
     Args:
@@ -42,6 +43,13 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches):
         processes — fold it into any stochastic-op RNG key so each
         microbatch draws its own masks; ignore it for deterministic
         stages.
+      remat: checkpoint each schedule step — backward recomputes the
+        stage body instead of storing its internals for every one of the
+        M + S - 1 steps.  This is the scan-compatible answer to 1F1B's
+        memory motivation: GPipe + autodiff stores O(steps) per-layer
+        activations per device, remat caps the stored state at the step
+        BOUNDARIES (one activation per step) and re-runs the stage in
+        backward, trading ~1 extra forward for the peak-memory cap.
       stage_params: this device's slice of the stage-stacked params — under
         ``shard_map`` with ``P('pipe', ...)`` in_spec each device receives a
         leading dim of 1; it is squeezed before calling ``stage_fn``.
@@ -103,6 +111,10 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches):
                            [(i, (i + 1) % n) for i in range(n)])
         return (nxt, buf), None
 
+    if remat:
+        # prevent_cse=False: safe (and recommended) under lax.scan — the
+        # default optimization barriers would block CSE for no benefit
+        step = jax.checkpoint(step, prevent_cse=False)
     (_, buf), _ = lax.scan(step, (state0, buf0), jnp.arange(steps))
     # broadcast the last stage's buffer to every device
     buf = jnp.where(idx == n - 1, buf, jnp.zeros_like(buf))
