@@ -117,6 +117,14 @@ register("MXNET_PALLAS_INTERPRET", bool, False,
          "Run Pallas kernels in interpret mode on non-TPU backends instead "
          "of falling back to einsum (slow; for testing the kernel dispatch "
          "path on CPU).")
+register("MXNET_RING_ATTENTION", bool, True,
+         "Under a mesh whose 'seq' axis is sharded (and 'model' is not), "
+         "dot_product_attention dispatches to explicit-collective ring "
+         "attention (parallel/ring.py) inside the executor program: K/V "
+         "blocks rotate via ppermute with O(T/n) memory per device, and "
+         "the per-hop compute is the Pallas flash kernel on TPU.  Set 0 "
+         "to restore the GSPMD einsum path (the partitioner's all-gather "
+         "plan) for A/B comparison.")
 register("MXNET_TP_MODE", str, "megatron",
          "Tensor-parallel sharding plan over the 'model' mesh axis: "
          "'megatron' (default) pairs column-parallel with row-parallel "

@@ -78,6 +78,35 @@ def register_all():
         scale = attrs.get("scale", 0.0) or None
         from .. import config as _config
 
+        # mesh path: with the time axis sharded on 'seq' (and no model-axis
+        # head sharding to preserve), run explicit-collective ring
+        # attention INSIDE the executor program — a shard_map region whose
+        # per-hop compute is the flash kernel on TPU — instead of leaving
+        # the partitioner to all-gather K/V.  This is how the flagship
+        # long-context path becomes Module-reachable.
+        if octx.mesh is not None and _config.get("MXNET_RING_ATTENTION"):
+            mesh_axes = dict(octx.mesh.shape)
+            b, tq, e = q.shape
+            if (mesh_axes.get("seq", 1) > 1 and mesh_axes.get("model", 1) == 1
+                    and k.shape[1] == tq and v.shape[1] == tq
+                    and tq % mesh_axes["seq"] == 0
+                    and b % mesh_axes.get("data", 1) == 0):
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                from ..parallel.ring import ring_attention
+
+                data_ax = "data" if mesh_axes.get("data", 1) > 1 else None
+                spec = P(data_ax, "seq", None)
+                ring = shard_map(
+                    lambda q_, k_, v_: ring_attention(
+                        q_, k_, v_, axis_name="seq", num_heads=heads,
+                        causal=causal, scale=scale),
+                    mesh=octx.mesh, in_specs=(spec,) * 3, out_specs=spec,
+                    check_vma=False)
+                PATH_TAKEN["last"] = "ring"
+                return [ring(q, k, v)], []
+
         # single-chip fast path, training AND inference (the backward
         # kernels + custom_vjp make pallas differentiable):
         #  - it is opaque to GSPMD -> mesh-sharded executors take einsum

@@ -361,3 +361,77 @@ def test_ring_flash_kernel_actually_traced():
     RING_PATH["last"] = None
     np.asarray(jax.jit(ring2)(q2, q2, q2))
     assert RING_PATH["last"] == "streaming"
+
+
+def test_module_seq_mesh_dispatches_to_ring(monkeypatch):
+    """With the time axis on 'seq', the executor's dot_product_attention
+    runs the explicit-collective ring INSIDE the program (the flagship
+    long-context path, Module-reachable) — and matches one device.
+    MXNET_RING_ATTENTION=0 restores the GSPMD einsum path."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.ops.attention import PATH_TAKEN
+
+    b, t, e, heads = 4, 16, 8, 2
+    rng = np.random.RandomState(8)
+
+    def build(contexts, mesh_config=None):
+        data = sym.Variable("data")
+        q = sym.FullyConnected(data, num_hidden=e, flatten=False, name="q")
+        k = sym.FullyConnected(data, num_hidden=e, flatten=False, name="k")
+        v = sym.FullyConnected(data, num_hidden=e, flatten=False, name="v")
+        att = sym.dot_product_attention(q, k, v, num_heads=heads,
+                                        causal=True)
+        net = sym.FullyConnected(att, num_hidden=4, name="head")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=contexts, mesh_config=mesh_config)
+        desc = DataDesc("data", (b, t, e), layout="NTC")
+        mod.bind(data_shapes=[desc],
+                 label_shapes=[("softmax_label", (b,))])
+        return mod
+
+    mod1 = build(mx.cpu(0))
+    mod1.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    arg_params, aux_params = mod1.get_params()
+
+    modN = build([mx.cpu(i) for i in range(8)],
+                 mesh_config=MeshConfig(data=2, seq=4))
+    modN.init_params(arg_params=arg_params, aux_params=aux_params)
+
+    x = rng.normal(size=(b, t, e)).astype(np.float32)
+    y = rng.randint(0, 4, (b,)).astype(np.float32)
+    batch = DataBatch([nd.array(x)], [nd.array(y)])
+    mod1.forward(batch, is_train=True)
+    PATH_TAKEN["last"] = None
+    modN.forward(batch, is_train=True)
+    assert PATH_TAKEN["last"] == "ring", PATH_TAKEN
+    assert_almost_equal(modN.get_outputs()[0].asnumpy(),
+                        mod1.get_outputs()[0].asnumpy(),
+                        rtol=1e-4, atol=1e-5)
+    # backward through the in-program ring
+    mod1.backward()
+    modN.backward()
+    for name, a, b_ in zip(mod1._exec_group.param_names,
+                           mod1._exec_group.grad_arrays,
+                           modN._exec_group.grad_arrays):
+        if a is None:
+            continue
+        assert_almost_equal(b_.asnumpy(), a.asnumpy(), rtol=1e-3,
+                            atol=1e-4, names=(name + "_N", name + "_1"))
+
+    # kill switch restores the GSPMD einsum path
+    monkeypatch.setenv("MXNET_RING_ATTENTION", "0")
+    _config.refresh("MXNET_RING_ATTENTION")
+    try:
+        modE = build([mx.cpu(i) for i in range(8)],
+                     mesh_config=MeshConfig(data=2, seq=4))
+        modE.init_params(arg_params=arg_params, aux_params=aux_params)
+        PATH_TAKEN["last"] = None
+        modE.forward(batch, is_train=True)
+        assert PATH_TAKEN["last"] == "einsum", PATH_TAKEN
+        assert_almost_equal(modE.get_outputs()[0].asnumpy(),
+                            mod1.get_outputs()[0].asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+    finally:
+        _config.refresh("MXNET_RING_ATTENTION")
