@@ -169,7 +169,7 @@ def ring_row():
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from mxnet_tpu.parallel.ring import ring_attention

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Benchmark: long-context attention-LM training throughput across meshes.
+
+The long-context headline the ResNet bench (`bench.py`) never covered:
+a causal attention LM at T=8192, full training step (forward + backward +
+fused optimizer update, ONE donated XLA program), measured on the three
+canonical mesh shapes of the ring×TP composition story:
+
+* ``seq``     — sequence-only ring: (data=1, seq=n); ring attention with
+                K/V rotating over all n devices.
+* ``tp``      — Megatron tensor parallel only: (data=1, model=n); the
+                GSPMD einsum path (the partitioner all-gathers K/V — the
+                O(T) memory/comms plan ring exists to beat).
+* ``ring_tp`` — the composed (data, seq, model) mesh: head groups shard
+                over 'model' INSIDE the ring's shard_map region, each
+                model shard rotating only its own K/V slice.
+
+Mirrors bench.py's contract: ONE json line on stdout —
+``{"metric": "attention_lm_tokens_per_sec_t<T>", "value", "unit",
+"mfu", "vs_baseline"}`` — where the value is the ring×TP mesh rate and
+``vs_baseline`` is its speedup over the TP-only GSPMD einsum plan on the
+same chips.  Per-mesh detail (tokens/s, sustained TFLOP/s, MFU, traced
+attention path) goes to stderr, one json per mesh.
+
+Env knobs: BENCH_T, BENCH_BATCH, BENCH_EMBED, BENCH_HEADS, BENCH_VOCAB,
+BENCH_ITERS, BENCH_DTYPE, BENCH_MESHES (comma-filter, e.g. "seq,ring_tp").
+CPU runs shrink all dims and force an 8-virtual-device host platform so
+the meshes exist (same trick as tests/conftest.py).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# the virtual-device mesh must exist BEFORE jax initializes its backend
+if os.environ.get("JAX_PLATFORMS", "") == "cpu" and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+import numpy as np
+
+import bench as _bench  # PEAK_FLOPS table + device-kind matching
+
+
+def _flops_per_token(t, e, vocab, causal=True):
+    """Forward FLOPs per token of the attention LM (2 * MACs).
+
+    qkv projections (3 matmuls E->E) + attention scores/values against
+    T keys (halved by causal masking) + out-projection E->E + vocab head.
+    Embedding lookups are gathers, not FLOPs.  Training ~= 3x forward.
+    """
+    proj = 3 * 2 * e * e + 2 * e * e
+    attn = 4 * e * t * (0.5 if causal else 1.0)
+    head = 2 * e * vocab
+    return proj + attn + head
+
+
+def _mesh_configs(n):
+    """The three measured mesh shapes over n devices (insertion order =
+    report order; ring_tp last so its rate is the headline)."""
+    from mxnet_tpu.parallel import MeshConfig
+
+    cfgs = {
+        "seq": MeshConfig(data=1, seq=n),
+        "tp": MeshConfig(data=1, model=n),
+    }
+    if n >= 8:
+        cfgs["ring_tp"] = MeshConfig(data=2, seq=n // 4, model=2)
+    elif n >= 4:
+        cfgs["ring_tp"] = MeshConfig(data=1, seq=n // 2, model=2)
+    return cfgs
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.ops.attention import PATH_TAKEN
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    on_tpu = platform == "tpu"
+
+    t = int(os.environ.get("BENCH_T", "8192" if on_tpu else "256"))
+    b = int(os.environ.get("BENCH_BATCH", "2"))
+    e = int(os.environ.get("BENCH_EMBED", "2048" if on_tpu else "64"))
+    heads = int(os.environ.get("BENCH_HEADS", "16" if on_tpu else "4"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "8192" if on_tpu else "64"))
+    n_iters = int(os.environ.get("BENCH_ITERS", "10" if on_tpu else "2"))
+    dtype = os.environ.get("BENCH_DTYPE",
+                           "bfloat16" if on_tpu else "float32")
+    warmup = 3 if on_tpu else 1
+
+    mesh_filter = [m for m in
+                   os.environ.get("BENCH_MESHES", "").split(",") if m]
+
+    def build_lm():
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        emb = sym.Embedding(data, input_dim=vocab, output_dim=e,
+                            name="embed")
+        q = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="q")
+        k = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="k")
+        v = sym.FullyConnected(emb, num_hidden=e, flatten=False, name="v")
+        att = sym.dot_product_attention(q, k, v, num_heads=heads,
+                                        causal=True)
+        out = sym.FullyConnected(att, num_hidden=e, flatten=False,
+                                 name="proj")
+        head = sym.FullyConnected(sym.Reshape(out, shape=(-1, e)),
+                                  num_hidden=vocab, name="head")
+        return sym.SoftmaxOutput(head, sym.Reshape(label, shape=(-1,)),
+                                 name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, vocab, size=(b, t)).astype(np.float32)
+    y = np.concatenate([x[:, 1:], np.zeros((b, 1), np.float32)], axis=1)
+
+    ctx_fn = mx.tpu if on_tpu else mx.cpu
+    contexts = [ctx_fn(i) for i in range(n_dev)]
+    train_flops_per_token = 3 * _flops_per_token(t, e, vocab)
+    peak, kind = _bench._peak_for(jax.devices()[0])
+
+    results = {}
+    for name, cfg in _mesh_configs(n_dev).items():
+        if mesh_filter and name not in mesh_filter:
+            continue
+        mod = mx.mod.Module(build_lm(), context=contexts, mesh_config=cfg,
+                            compute_dtype=dtype)
+        data_desc = DataDesc("data", (b, t), layout="NT")
+        label_desc = DataDesc("softmax_label", (b, t), layout="NT")
+        mod.bind(data_shapes=[data_desc], label_shapes=[label_desc])
+        mod.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01,
+                                             "momentum": 0.9})
+        batch = DataBatch([nd.array(x)], [nd.array(y)],
+                          provide_data=[data_desc],
+                          provide_label=[label_desc])
+
+        def sync():
+            import jax.numpy as jnp
+
+            if mod._fused_step is not None:
+                src = next(iter(mod._fused_step.params.values()))
+            else:
+                src = mod._exec_group.param_arrays[-1].data
+            return float(jnp.sum(src.astype(jnp.float32)))
+
+        PATH_TAKEN["last"] = None
+        for _ in range(warmup):
+            mod.forward_backward(batch)
+            mod.update()
+        sync()
+        tic = time.time()
+        for _ in range(n_iters):
+            mod.forward_backward(batch)
+            mod.update()
+        sync()
+        dt = time.time() - tic
+
+        tok_s = b * t * n_iters / dt
+        tflops = tok_s * train_flops_per_token / 1e12
+        mfu = tflops * 1e12 / (peak * n_dev) if peak else None
+        results[name] = {"tokens_per_sec": round(tok_s, 1),
+                         "sustained_tflops": round(tflops, 2),
+                         "mfu": round(mfu, 4) if mfu is not None else None,
+                         "attention_path": PATH_TAKEN["last"]}
+        print(json.dumps({"mesh": name, "mesh_shape": {
+            "data": cfg.data, "seq": cfg.seq, "model": cfg.model},
+            "device": kind, "dtype": dtype, "T": t, "batch": b,
+            **results[name]}), file=sys.stderr, flush=True)
+
+    if not results:
+        sys.exit("no mesh measured: BENCH_MESHES=%r matched none of %s "
+                 "(ring_tp needs >= 4 devices; %d present)"
+                 % (os.environ.get("BENCH_MESHES", ""),
+                    sorted(_mesh_configs(n_dev)), n_dev))
+    headline = results.get("ring_tp") or next(iter(results.values()))
+    base = results.get("tp")
+    print(json.dumps({
+        "metric": "attention_lm_tokens_per_sec_t%d" % t,
+        "value": headline["tokens_per_sec"],
+        "unit": "tok/s",
+        "mfu": headline["mfu"],
+        "vs_baseline": (round(headline["tokens_per_sec"]
+                              / base["tokens_per_sec"], 3)
+                        if base else None),
+    }))
+
+
+if __name__ == "__main__":
+    main()

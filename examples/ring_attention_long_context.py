@@ -23,6 +23,15 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# old-jax fallback for the 8-virtual-device mesh (no ``jax_num_cpu_devices``
+# option there): the XLA flag must be in place before backend init, and env
+# mutation only works before jax is imported
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 import jax
 
 # must happen before backend init; on a TPU machine the platform is
@@ -34,8 +43,9 @@ except Exception:
 
 import numpy as np
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from mxnet_tpu.parallel.compat import shard_map
 
 from mxnet_tpu.parallel.ring import ring_attention, dense_attention, RING_PATH
 

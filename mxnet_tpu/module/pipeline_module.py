@@ -127,6 +127,114 @@ def _stage_structure_signature(symbol):
     return tuple(sig)
 
 
+# ---------------------------------------------------------------------------
+# zero-preservation scan for width-padded heterogeneous stages
+# ---------------------------------------------------------------------------
+# Padded weight columns/rows are zero, so padded activation lanes stay zero
+# through the projections — but only while every elementwise op in between
+# maps 0 -> 0 (and finitely).  The guard used to inspect `Activation` nodes
+# only, so elementwise ops registered under their own names (sym.sigmoid,
+# sym.exp, sym.cos, softrelu, SoftmaxActivation, _plus_scalar, ...) slipped
+# past the bind-time rejection and silently animated the padded lanes.  The
+# scan now covers the whole elementwise universe: an allowlist of known
+# f(0)=0 ops, attr-conditional checks for the handful whose behaviour at 0
+# depends on parameters, and fail-closed rejection for every other
+# elementwise-family name (so a newly registered f(0)!=0 op is caught here
+# rather than corrupting training).
+
+# elementwise ops with f(0) = 0 and finite, unconditionally safe on padded
+# lanes (LeakyReLU: every act_type — leaky/elu/prelu/rrelu — fixes 0).
+# Two-input forms are listed when f(0, 0) = 0 and finite — both operands
+# of an in-stage binary op carry the same zeroed padded lanes (the stage's
+# lane-locality contract): add/sub/mul/max/min/hypot qualify; div and mod
+# (0/0 = nan), power (0^0 = 1), and the =/>=/<= comparisons (f(0,0) = 1)
+# do not and are caught fail-closed below.
+_ZERO_PRESERVING_ELEMWISE = frozenset({
+    "abs", "sign", "rint", "ceil", "floor", "trunc", "fix", "round",
+    "square", "sqrt", "cbrt", "expm1", "log1p", "sin", "tan", "arcsin",
+    "arctan", "sinh", "tanh", "arcsinh", "arctanh", "degrees", "radians",
+    "erf", "negative", "relu", "softsign", "smooth_l1",
+    "_copy", "Cast", "Dropout", "LeakyReLU", "BlockGrad",
+    "_mul_scalar", "_div_scalar", "_mod_scalar",
+    "_plus", "_minus", "_mul", "_maximum", "_minimum", "_hypot",
+    "broadcast_add", "broadcast_sub", "broadcast_mul",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_hypot",
+    "broadcast_not_equal", "broadcast_greater", "broadcast_lesser",
+    "add_n", "_grad_add",
+})
+
+# Activation act_types with f(0) = 0
+_ZERO_PRESERVING_ACT_TYPES = ("relu", "tanh", "softsign")
+
+
+_ELEMWISE_FAMILY = None  # computed once, first padded-stage bind
+
+
+def _elementwise_family():
+    """Every registered elementwise-family op name: the live unary table,
+    the two-tensor/broadcast/scalar binary and logic forms, and the nn
+    activation wrappers.  Built from the op tables themselves so new
+    elementwise registrations are covered without touching this module."""
+    global _ELEMWISE_FAMILY
+    if _ELEMWISE_FAMILY is not None:
+        return _ELEMWISE_FAMILY
+    from ..ops.elemwise import _unary_table
+
+    names = set(_unary_table())
+    binary = ("plus", "minus", "mul", "div", "mod", "power", "maximum",
+              "minimum", "hypot")
+    logic = ("equal", "not_equal", "greater", "greater_equal", "lesser",
+             "lesser_equal")
+    # canonical registered names (aliases resolve to these): the
+    # two-tensor form is _<name>, the broadcast form broadcast_<canon>
+    # for arithmetic and broadcast_<name> for logic
+    canon = {"plus": "add", "minus": "sub"}
+    names.update("_%s" % n for n in binary)
+    names.update("broadcast_%s" % canon.get(n, n) for n in binary)
+    names.update("_%s_scalar" % n for n in binary + logic)
+    names.update("_r%s_scalar" % n for n in ("minus", "div", "power", "mod"))
+    names.update("broadcast_%s" % n for n in logic)
+    names.update({"Activation", "LeakyReLU", "SoftmaxActivation", "clip",
+                  "smooth_l1", "Cast", "_copy", "Dropout", "BlockGrad",
+                  "add_n", "_grad_add"})
+    _ELEMWISE_FAMILY = frozenset(names)
+    return _ELEMWISE_FAMILY
+
+
+def _zero_preservation_violation(node):
+    """Why this node breaks f(0)=0 on padded lanes, or None when safe.
+
+    Non-elementwise ops (projections, reshapes, reductions) return None
+    too: they are governed by the stage-structure / lane-locality contract
+    in the class docstring, not by this scan.
+    """
+    name = node.op.name
+    attrs = node.parsed_attrs()
+    if name == "Activation":
+        act = attrs.get("act_type", "relu")
+        if act in _ZERO_PRESERVING_ACT_TYPES:
+            return None
+        return "Activation act_type=%r" % act
+    if name == "clip":
+        lo, hi = attrs.get("a_min", 0.0), attrs.get("a_max", 0.0)
+        return None if lo <= 0.0 <= hi else \
+            "clip bounds [%s, %s] excluding 0" % (lo, hi)
+    if name == "_power_scalar":
+        c = attrs.get("scalar", 0.0)
+        return None if c > 0 else "_power_scalar exponent %s" % c
+    if name == "_maximum_scalar":
+        c = attrs.get("scalar", 0.0)
+        return None if c <= 0 else "_maximum_scalar with scalar %s" % c
+    if name == "_minimum_scalar":
+        c = attrs.get("scalar", 0.0)
+        return None if c >= 0 else "_minimum_scalar with scalar %s" % c
+    if name in _ZERO_PRESERVING_ELEMWISE:
+        return None
+    if name in _elementwise_family():
+        return "%r (f(0) != 0)" % name
+    return None
+
+
 class PipelineModule(BaseModule):
     def __init__(self, stage_symbol, head_symbol, num_stages,
                  num_microbatches, embed_symbol=None, context=None,
@@ -284,15 +392,16 @@ class PipelineModule(BaseModule):
                 if not padded:
                     continue
                 for node in s._topo():
-                    if node.is_variable or node.op.name != "Activation":
+                    if node.is_variable:
                         continue
-                    act = node.parsed_attrs().get("act_type", "relu")
-                    if act not in ("relu", "tanh", "softsign"):
+                    why = _zero_preservation_violation(node)
+                    if why is not None:
                         raise MXNetError(
                             "heterogeneous pipeline stage %d is width-"
-                            "padded and needs zero-preserving activations"
-                            " (f(0)=0: relu/tanh/softsign); %r would turn"
-                            " the zero padding into live lanes" % (k, act))
+                            "padded and needs zero-preserving elementwise"
+                            " ops (f(0)=0, e.g. relu/tanh/softsign); %s "
+                            "would turn the zero padding into live lanes"
+                            % (k, why))
 
         head_kwargs = {"data": (batch,) + tuple(act_shape[1:])}
         for d in self._label_shapes:
@@ -457,7 +566,7 @@ class PipelineModule(BaseModule):
     def _build_step(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from ..parallel.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.pipeline import pipeline_apply
@@ -541,7 +650,7 @@ class PipelineModule(BaseModule):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from ..parallel.compat import shard_map
 
         from ..parallel.pipeline import pipeline_apply
 

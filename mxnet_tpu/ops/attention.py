@@ -78,30 +78,45 @@ def register_all():
         scale = attrs.get("scale", 0.0) or None
         from .. import config as _config
 
-        # mesh path: with the time axis sharded on 'seq' (and no model-axis
-        # head sharding to preserve), run explicit-collective ring
-        # attention INSIDE the executor program — a shard_map region whose
-        # per-hop compute is the flash kernel on TPU — instead of leaving
-        # the partitioner to all-gather K/V.  This is how the flagship
-        # long-context path becomes Module-reachable.
+        # mesh path: with the time axis sharded on 'seq', run
+        # explicit-collective ring attention INSIDE the executor program —
+        # a shard_map region whose per-hop compute is the flash kernel on
+        # TPU — instead of leaving the partitioner to all-gather K/V.
+        # Ring attention is per-head independent, so Megatron head-group
+        # sharding on 'model' composes with the K/V rotation on 'seq': the
+        # in/out specs carry 'model' on the embed dim (an E-split IS a
+        # head-group split — heads are contiguous hd-wide slices of E),
+        # and each model shard rotates only its own K/V slice — the full
+        # ring×TP (data, seq, model) composition, Module-reachable.
         if octx.mesh is not None and _config.get("MXNET_RING_ATTENTION"):
             mesh_axes = dict(octx.mesh.shape)
             b, tq, e = q.shape
-            if (mesh_axes.get("seq", 1) > 1 and mesh_axes.get("model", 1) == 1
-                    and k.shape[1] == tq and v.shape[1] == tq
-                    and tq % mesh_axes["seq"] == 0
+            seq_par = mesh_axes.get("seq", 1)
+            model_par = mesh_axes.get("model", 1)
+            # e % heads (and the value dim alike) must hold BEFORE taking
+            # the shard_map path: a malformed head config must fall through
+            # to the einsum kernel's explicit assert, not surface as a
+            # reshape trace error inside the ring region.  heads % model
+            # keeps head groups whole per model shard; indivisible configs
+            # degrade to the GSPMD einsum, never to wrong numbers.
+            if (seq_par > 1 and k.shape[1] == tq and v.shape[1] == tq
+                    and heads > 0 and e % heads == 0
+                    and v.shape[2] % heads == 0
+                    and heads % model_par == 0
+                    and tq % seq_par == 0
                     and b % mesh_axes.get("data", 1) == 0):
-                from jax import shard_map
                 from jax.sharding import PartitionSpec as P
 
+                from ..parallel.compat import shard_map
                 from ..parallel.ring import ring_attention
 
                 data_ax = "data" if mesh_axes.get("data", 1) > 1 else None
-                spec = P(data_ax, "seq", None)
+                model_ax = "model" if model_par > 1 else None
+                spec = P(data_ax, "seq", model_ax)
                 ring = shard_map(
                     lambda q_, k_, v_: ring_attention(
                         q_, k_, v_, axis_name="seq", num_heads=heads,
-                        causal=causal, scale=scale),
+                        causal=causal, scale=scale, head_axis=model_ax),
                     mesh=octx.mesh, in_specs=(spec,) * 3, out_specs=spec,
                     check_vma=False)
                 PATH_TAKEN["last"] = "ring"

@@ -6,6 +6,8 @@ mesh.  `tools/launch.py` (dmlc-tracker ssh/mpi) becomes
 `mxnet_tpu.parallel.launch.init()` → jax.distributed.
 """
 from . import collectives
+from . import compat
+from .compat import shard_map
 from .mesh import build_mesh, data_parallel_mesh, MeshConfig
 from . import launch
 from . import ring
@@ -14,6 +16,7 @@ from . import pipeline
 from .pipeline import pipeline_apply, stack_stage_params
 from . import health
 
-__all__ = ["collectives", "build_mesh", "data_parallel_mesh", "MeshConfig",
-           "launch", "ring", "ring_attention", "pipeline", "pipeline_apply",
+__all__ = ["collectives", "compat", "shard_map", "build_mesh",
+           "data_parallel_mesh", "MeshConfig", "launch", "ring",
+           "ring_attention", "pipeline", "pipeline_apply",
            "stack_stage_params", "health"]

@@ -91,11 +91,14 @@ def _start_bytes(op, shape_s):
     The tuple layout is op-specific (verified against compiled HLO):
     ``all-reduce-start`` has the SAME shape as the sync op — a flat tuple
     of results when XLA combined several all-reduces — so every buffer
-    counts.  ``all-gather-start`` / ``collective-permute-start`` carry
+    counts.  ``all-gather-start`` / ``reduce-scatter-start`` /
+    ``collective-permute-start`` carry
     ``(operand(s), result(s), [u32 context scalars...])`` — count only
     the result element (itself possibly a tuple for grouped ops).
-    Summing naively would double those; taking the single largest buffer
-    (the old rule) undercounts any grouped form.
+    Summing naively would double those (reduce-scatter-start used to fall
+    into the generic fallback and did exactly that, inflating absolute
+    KiB/step); taking the single largest buffer (the old rule)
+    undercounts any grouped form.
     """
     parts = _split_top_level(shape_s)
     parts = [p for p in parts
@@ -104,7 +107,8 @@ def _start_bytes(op, shape_s):
         return 0
     if op == "all-reduce":
         return sum(shape_bytes(p) for p in parts)
-    if op in ("all-gather", "collective-permute") and len(parts) >= 2:
+    if op in ("all-gather", "reduce-scatter", "collective-permute") \
+            and len(parts) >= 2:
         return shape_bytes(parts[1])
     # generic async wrapper: ((operands...), results, ctx) — a leading
     # tuple element marks the operand pack; otherwise flat results

@@ -85,10 +85,13 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, num_microbatches,
 
     steps = m + n - 1
     # carries become device-varying over the pipe axis inside the scan, so
-    # the initial values must be marked varying too (shard_map vma typing);
-    # zeros_like inherits whatever axes x already varies over (e.g. 'data')
-    state0 = lax.pcast(jnp.zeros_like(x[0]), (axis_name,), to="varying")
-    buf0 = lax.pcast(jnp.zeros_like(x), (axis_name,), to="varying")
+    # the initial values must be marked varying too (shard_map vma typing;
+    # identity on jax versions without the vma type system); zeros_like
+    # inherits whatever axes x already varies over (e.g. 'data')
+    from .compat import pvary
+
+    state0 = pvary(jnp.zeros_like(x[0]), (axis_name,))
+    buf0 = pvary(jnp.zeros_like(x), (axis_name,))
 
     def step(carry, s):
         state, buf = carry

@@ -19,6 +19,14 @@ Usage (under shard_map over a mesh with a ``seq`` axis):
         in_specs=(P(None, "seq", None),) * 3,
         out_specs=P(None, "seq", None),
     )(q, k, v)
+
+Ring×TP composition (the full (data, seq, model) mesh): attention is
+per-head independent, so Megatron head-group sharding on 'model' rides
+along by additionally splitting the embed dim in the specs —
+``P(None, "seq", "model")`` — and passing ``head_axis="model"`` with the
+global head count; each model shard then rotates only its own K/V slice.
+``dot_product_attention``'s mesh dispatch (ops/attention.py) builds
+exactly this region.
 """
 from __future__ import annotations
 
@@ -44,16 +52,28 @@ def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
 
 
 def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
-                   scale=None, use_flash=None, interpret=None):
+                   scale=None, use_flash=None, interpret=None,
+                   head_axis=None):
     """Blockwise ring attention over the ``axis_name`` mesh axis.
 
-    Args are the LOCAL sequence blocks (B, T_local, E).  Device i starts
-    with K/V block i; each of the ``n`` ring steps attends Q_local against
-    the currently-held K/V block, then rotates K/V to the next device with
-    ``lax.ppermute``.  A running (max, sum, acc) triple merges blocks with
-    exact flash-attention numerics, and causal masking uses the global
-    block offsets, so the result equals dense attention on the gathered
-    sequence.
+    Args are the LOCAL sequence blocks (B, T_local, E_local).  Device i
+    starts with K/V block i; each of the ``n`` ring steps attends Q_local
+    against the currently-held K/V block, then rotates K/V to the next
+    device with ``lax.ppermute``.  A running (max, sum, acc) triple merges
+    blocks with exact flash-attention numerics, and causal masking uses
+    the global block offsets, so the result equals dense attention on the
+    gathered sequence.
+
+    ``head_axis`` composes the ring with Megatron tensor parallelism
+    (ring×TP): attention is per-head independent, so when the embed dim is
+    additionally sharded over a 'model' mesh axis in whole head groups
+    (E_local = E / model, heads contiguous hd-wide slices of E), pass
+    ``head_axis='model'`` with the GLOBAL ``num_heads`` — the per-shard
+    head count is derived from the axis size, and every ppermute moves
+    only this shard's (B, T_local, E/model) K/V slice: collectives shrink
+    by the model degree while the 'seq' ring math is untouched (the same
+    holds for the custom-VJP backward ring, whose dK/dV accumulators are
+    sliced identically).
 
     Per-hop compute dispatches to the Pallas flash kernel
     (``ops.pallas_attention``) when the local block fits it (T_local
@@ -77,12 +97,35 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, e = q.shape
+    if head_axis is not None:
+        # head-group sharding: axis sizes are static, so psum(1, axis)
+        # folds to a Python int and num_heads becomes the per-shard count
+        head_par = lax.psum(1, head_axis)
+        assert num_heads % head_par == 0, \
+            "num_heads %d not divisible by %r axis size %d" \
+            % (num_heads, head_axis, head_par)
+        num_heads //= head_par
     hd = e // num_heads
     ev = v.shape[2] // num_heads
     scale = scale or 1.0 / np.sqrt(hd)
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+        if use_flash and interpret:
+            # use_flash=True on a non-TPU backend silently resolves to
+            # Pallas interpreter mode — every ring hop runs orders of
+            # magnitude slower than the compiled kernel.  Tests opt in
+            # with an explicit interpret=True; anything else should hear
+            # about it.
+            import warnings
+
+            warnings.warn(
+                "ring_attention(use_flash=True) on the %r backend resolves"
+                " to Pallas interpreter mode (orders of magnitude slower "
+                "than the compiled TPU kernel); pass interpret=True to "
+                "acknowledge, or use_flash=False for the jnp streaming "
+                "path" % jax.default_backend(), RuntimeWarning,
+                stacklevel=2)
     if use_flash is None:
         # auto: the real kernel on TPU whenever the local block fits it;
         # interpreter-mode emulation is opt-in (tests), not a default.

@@ -6,10 +6,19 @@ This is the TPU analog of the reference's fake-device trick
 multi-chip sharding paths are exercised on one box.  Note: this environment
 pre-imports jax at interpreter startup (TPU platform hook), so env vars are
 too late — jax.config.update is the reliable path.  XLA_FLAGS still works
-because no backend is initialized until the first device query.
+because no backend is initialized until the first device query; older jax
+releases (< 0.5, no ``jax_num_cpu_devices`` option) take that route.
 """
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 jax.config.update("jax_enable_x64", True)

@@ -44,7 +44,7 @@ def _sequential(per_stage, x_flat):
 @pytest.mark.parametrize("n_stages,micro", [(4, 4), (4, 8), (8, 4)])
 def test_pipeline_matches_sequential(n_stages, micro):
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     rng = np.random.RandomState(0)
@@ -69,7 +69,7 @@ def test_pipeline_grads_match_sequential():
     reverse (backward) pipeline emerges from differentiating the scan."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     rng = np.random.RandomState(1)
@@ -223,7 +223,7 @@ def test_pipeline_dropout_masks_differ_per_microbatch():
     stage's microbatches (round-4 verdict, Weak #4)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_stages, micro, mb, d = 4, 4, 8, 16
@@ -292,7 +292,7 @@ def test_pipeline_module_rejects_stateful_stage():
 def test_pipeline_composes_with_data_axis():
     """(pipe=4, data=2) mesh: pipeline over stages, batch sharded on data."""
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     rng = np.random.RandomState(2)
@@ -516,7 +516,7 @@ def test_pipeline_remat_same_grads_less_memory():
     temp memory — the scan-compatible answer to 1F1B's memory motivation."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     rng = np.random.RandomState(0)
@@ -569,3 +569,46 @@ def test_pipeline_module_remat_trains():
     it.reset()
     score = dict(pipe.score(it, "acc"))
     assert score["accuracy"] > 0.9, score
+
+
+def test_pipeline_zero_preservation_guard_covers_all_elementwise():
+    """The bind-time f(0)=0 guard must cover elementwise ops registered
+    under their own names, not just `Activation` act_types: sym.sigmoid,
+    sym.exp, sym.cos, softrelu (softplus) and SoftmaxActivation all map
+    padded zero lanes to non-zero values and must be rejected on
+    width-padded heterogeneous stages — while zero-preserving elementwise
+    ops (sym.sin, scalar multiply) must still bind."""
+    from mxnet_tpu import symbol as sym
+
+    def stage(mid, h):
+        s = sym.FullyConnected(sym.Variable("data"), num_hidden=h,
+                               name="fc_in")
+        s = mid(s)
+        return sym.FullyConnected(s, num_hidden=8, name="fc_out")
+
+    def bind(mid):
+        mx.mod.PipelineModule(
+            [stage(mid, 4), stage(mid, 6)], _head_sym(2),
+            num_stages=2, num_microbatches=2,
+            context=[mx.cpu(i) for i in range(4)]) \
+            .bind(data_shapes=[("data", (8, 8))])
+
+    for bad in (sym.sigmoid, sym.exp, sym.cos,
+                lambda s: sym.Activation(s, act_type="softrelu"),
+                sym.SoftmaxActivation,
+                lambda s: s + 1.0,                    # _plus_scalar
+                lambda s: sym._maximum_scalar(s, scalar=0.5),
+                lambda s: sym.clip(s, a_min=0.5, a_max=2.0),
+                # two-input forms: f(0, 0) != 0 (or nan) on padded lanes
+                lambda s: s / s,                      # _div: 0/0 = nan
+                lambda s: sym.broadcast_equal(s, s)):  # f(0,0) = 1
+        with pytest.raises(mx.base.MXNetError, match="zero-preserving"):
+            bind(bad)
+
+    # zero-preserving elementwise ops pass the extended scan
+    for good in (sym.sin, sym.tanh, lambda s: s * 2.0,
+                 lambda s: sym.clip(s, a_min=-1.0, a_max=1.0),
+                 lambda s: sym.LeakyReLU(s, act_type="elu"),
+                 lambda s: s + s, lambda s: s * s,    # f(0,0) = 0 binaries
+                 lambda s: sym.broadcast_maximum(s, s)):
+        bind(good)

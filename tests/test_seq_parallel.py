@@ -107,7 +107,7 @@ def test_attention_in_symbol_graph():
 @pytest.mark.parametrize("seq_par", [4, 8])
 def test_ring_attention_matches_dense(causal, seq_par):
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     rng = np.random.RandomState(4)
@@ -132,7 +132,7 @@ def test_ring_attention_matches_dense(causal, seq_par):
 
 def test_ring_attention_grads_match_dense():
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     rng = np.random.RandomState(5)
@@ -267,7 +267,7 @@ def test_ring_flash_matches_dense(causal, seq_par):
     """Ring attention with the flash kernel inside (use_flash=True,
     interpreter mode on CPU) == dense attention — fwd numerics."""
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from mxnet_tpu.parallel.ring import RING_PATH
@@ -298,7 +298,7 @@ def test_ring_flash_grads_match_dense():
     """Training through the flash ring: the custom_vjp's backward ring
     (dK/dV accumulators rotating with their blocks) == dense grads."""
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     rng = np.random.RandomState(7)
@@ -332,7 +332,7 @@ def test_ring_flash_kernel_actually_traced():
     equations (the kernel, not jnp streaming math), and the auto dispatch
     must pick streaming for kernel-unfriendly local blocks."""
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from mxnet_tpu.parallel.ring import RING_PATH
@@ -473,3 +473,298 @@ def test_module_ring_attention_fit_converges():
     it.reset()
     score = dict(mod.score(it, "acc"))
     assert score["accuracy"] > 0.9, score
+
+
+# ---------------------------------------------------------------------------
+# ring × tensor parallelism: head-sharded ring attention on (data, seq,
+# model) meshes — the Megatron composition (heads are per-ring independent,
+# so head groups shard over 'model' while K/V blocks rotate over 'seq')
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_tp_matches_dense(causal):
+    """Head-sharded streaming ring on a (data=2, seq=2, model=2) mesh ==
+    dense attention: each model shard rotates only its own K/V slice."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel.compat import shard_map
+
+    rng = np.random.RandomState(10)
+    b, t, e, heads = 2, 16, 16, 4
+    q, k, v = [rng.normal(size=(b, t, e)).astype(np.float32)
+               for _ in range(3)]
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "seq", "model"))
+    spec = P("data", "seq", "model")
+    ring = shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="seq",
+                                          num_heads=heads, causal=causal,
+                                          head_axis="model"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+    out = np.asarray(jax.jit(ring)(q, k, v))
+    ref = np.asarray(dense_attention(q, k, v, num_heads=heads,
+                                     causal=causal))
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(out, _np_sdpa(q, k, v, heads, causal), rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_ring_tp_flash_matches_dense():
+    """The custom-VJP flash ring under head sharding (model axis on the
+    folded head dim): fwd numerics and the backward ring's dK/dV
+    accumulators — each shard's gradients for ITS head group only."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel.compat import shard_map
+    from mxnet_tpu.parallel.ring import RING_PATH
+
+    rng = np.random.RandomState(11)
+    b, t, e, heads = 1, 512, 256, 2
+    q, k, v = [rng.normal(size=(b, t, e)).astype(np.float32)
+               for _ in range(3)]
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("seq", "model"))
+    spec = P(None, "seq", "model")
+    ring = shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="seq",
+                                          num_heads=heads, causal=True,
+                                          use_flash=True, interpret=True,
+                                          head_axis="model"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+    RING_PATH["last"] = None
+    out = np.asarray(jax.jit(ring)(q, k, v))
+    assert RING_PATH["last"] == "flash"
+    ref = np.asarray(dense_attention(q, k, v, num_heads=heads, causal=True))
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+    def loss_ring(q_, k_, v_):
+        return (ring(q_, k_, v_) ** 2).sum()
+
+    def loss_dense(q_, k_, v_):
+        return (dense_attention(q_, k_, v_, num_heads=heads,
+                                causal=True) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_dense):
+        assert_almost_equal(np.asarray(a), np.asarray(b_), rtol=1e-3,
+                            atol=1e-4)
+
+
+def test_ring_tp_gradient_finite_difference():
+    """Finite-difference check through the head-sharded backward ring:
+    directional derivatives of a scalar loss match central differences."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel.compat import shard_map
+
+    rng = np.random.RandomState(12)
+    b, t, e, heads = 1, 8, 8, 2
+    q, k, v = [rng.normal(size=(b, t, e)).astype(np.float64)
+               for _ in range(3)]
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "seq", "model"))
+    spec = P(None, "seq", "model")
+    ring = shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="seq",
+                                          num_heads=heads, causal=True,
+                                          head_axis="model"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+    w = rng.normal(size=(b, t, e))
+
+    def loss(q_, k_, v_):
+        return jnp.sum(ring(q_, k_, v_) * w)
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    # ring internals accumulate in float32, so directional FD agreement is
+    # bounded by kernel precision, not the f64 inputs — same tolerance
+    # regime as check_numeric_gradient elsewhere in the suite
+    eps = 1e-3
+    for i, (x, g) in enumerate(zip((q, k, v), grads)):
+        d = rng.normal(size=x.shape)
+        args_p = [q, k, v]
+        args_m = [q, k, v]
+        args_p[i] = x + eps * d
+        args_m[i] = x - eps * d
+        fd = (float(loss(*args_p)) - float(loss(*args_m))) / (2 * eps)
+        analytic = float(np.sum(np.asarray(g) * d))
+        np.testing.assert_allclose(analytic, fd, rtol=0.02,
+                                   err_msg="arg %d" % i)
+
+
+def test_module_ring_tp_mesh_dispatches_to_ring():
+    """PATH_TAKEN tripwire on the full (data=2, seq=2, model=2) mesh: the
+    traced path must be ring when model > 1 (head groups shard over
+    'model'), and forward/backward must match one device."""
+    from mxnet_tpu.ops.attention import PATH_TAKEN
+
+    b, t, e, heads = 4, 16, 16, 4
+    rng = np.random.RandomState(13)
+
+    def build(contexts, mesh_config=None):
+        data = sym.Variable("data")
+        q = sym.FullyConnected(data, num_hidden=e, flatten=False, name="q")
+        k = sym.FullyConnected(data, num_hidden=e, flatten=False, name="k")
+        v = sym.FullyConnected(data, num_hidden=e, flatten=False, name="v")
+        att = sym.dot_product_attention(q, k, v, num_heads=heads,
+                                        causal=True)
+        net = sym.FullyConnected(att, num_hidden=4, name="head")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=contexts, mesh_config=mesh_config)
+        mod.bind(data_shapes=[DataDesc("data", (b, t, e), layout="NTC")],
+                 label_shapes=[("softmax_label", (b,))])
+        return mod
+
+    mod1 = build(mx.cpu(0))
+    mod1.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    arg_params, aux_params = mod1.get_params()
+
+    modN = build([mx.cpu(i) for i in range(8)],
+                 mesh_config=MeshConfig(data=2, seq=2, model=2))
+    modN.init_params(arg_params=arg_params, aux_params=aux_params)
+
+    x = rng.normal(size=(b, t, e)).astype(np.float32)
+    y = rng.randint(0, 4, (b,)).astype(np.float32)
+    batch = DataBatch([nd.array(x)], [nd.array(y)])
+    mod1.forward(batch, is_train=True)
+    PATH_TAKEN["last"] = None
+    modN.forward(batch, is_train=True)
+    assert PATH_TAKEN["last"] == "ring", PATH_TAKEN
+    assert_almost_equal(modN.get_outputs()[0].asnumpy(),
+                        mod1.get_outputs()[0].asnumpy(),
+                        rtol=1e-4, atol=1e-5)
+    mod1.backward()
+    modN.backward()
+    for name, a, b_ in zip(mod1._exec_group.param_names,
+                           mod1._exec_group.grad_arrays,
+                           modN._exec_group.grad_arrays):
+        if a is None:
+            continue
+        assert_almost_equal(b_.asnumpy(), a.asnumpy(), rtol=1e-3,
+                            atol=1e-4, names=(name + "_N", name + "_1"))
+
+
+def test_module_ring_tp_fewer_collective_bytes(monkeypatch):
+    """hlo_stats contract on the identical (2, 2, 2) mesh: the ring×TP
+    train step must move strictly fewer collective bytes (and fewer
+    collectives) than the GSPMD einsum plan, and compute the same step."""
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
+
+    b, t, e, heads = 4, 64, 16, 4
+    rng = np.random.RandomState(14)
+    x = rng.normal(size=(b, t, e)).astype(np.float32)
+    y = rng.randint(0, 4, (b,)).astype(np.float32)
+
+    def step_hlo(ring_on):
+        monkeypatch.setenv("MXNET_RING_ATTENTION", "1" if ring_on else "0")
+        _config.refresh("MXNET_RING_ATTENTION")
+        try:
+            data = sym.Variable("data")
+            q = sym.FullyConnected(data, num_hidden=e, flatten=False,
+                                   name="q")
+            k = sym.FullyConnected(data, num_hidden=e, flatten=False,
+                                   name="k")
+            v = sym.FullyConnected(data, num_hidden=e, flatten=False,
+                                   name="v")
+            att = sym.dot_product_attention(q, k, v, num_heads=heads,
+                                            causal=True)
+            net = sym.FullyConnected(att, num_hidden=4, name="head")
+            net = sym.SoftmaxOutput(net, name="softmax")
+            mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                                mesh_config=MeshConfig(data=2, seq=2,
+                                                       model=2))
+            mod.bind(data_shapes=[DataDesc("data", (b, t, e),
+                                           layout="NTC")],
+                     label_shapes=[("softmax_label", (b,))])
+            np.random.seed(16)  # identical params under both paths
+            mod.init_params(mx.initializer.Xavier())
+            batch = DataBatch([nd.array(x)], [nd.array(y)])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            out = mod.get_outputs()[0].asnumpy()
+            hlo = mod._exec_group.exec_.compiled_hlo()
+        finally:
+            _config.refresh("MXNET_RING_ATTENTION")
+        return hlo, out
+
+    hlo_r, out_r = step_hlo(True)
+    hlo_e, out_e = step_hlo(False)
+    assert_almost_equal(out_r, out_e, rtol=1e-4, atol=1e-5)
+    st_r = collective_stats(hlo_r)
+    st_e = collective_stats(hlo_e)
+    assert st_r["total"]["bytes"] < st_e["total"]["bytes"], (st_r, st_e)
+    assert st_r["total"]["count"] < st_e["total"]["count"], (st_r, st_e)
+
+
+def test_ring_dispatch_rejects_malformed_head_configs():
+    """e % heads != 0 must fall through to the einsum path's explicit
+    assert (not a reshape trace error inside shard_map); heads % model
+    != 0 must degrade to the einsum path, never to wrong numbers."""
+    from mxnet_tpu.ops.attention import PATH_TAKEN
+
+    def build(e, heads, mesh_config):
+        b, t = 4, 16
+        data = sym.Variable("data")
+        q = sym.FullyConnected(data, num_hidden=e, flatten=False, name="q")
+        k = sym.FullyConnected(data, num_hidden=e, flatten=False, name="k")
+        v = sym.FullyConnected(data, num_hidden=e, flatten=False, name="v")
+        att = sym.dot_product_attention(q, k, v, num_heads=heads)
+        net = sym.SoftmaxOutput(sym.FullyConnected(att, num_hidden=4,
+                                                   name="head"),
+                                name="softmax")
+        mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                            mesh_config=mesh_config)
+        mod.bind(data_shapes=[DataDesc("data", (b, t, e), layout="NTC")],
+                 label_shapes=[("softmax_label", (b,))])
+        mod.init_params(mx.initializer.Xavier())
+        rng = np.random.RandomState(17)
+        x = rng.normal(size=(b, t, e)).astype(np.float32)
+        y = rng.randint(0, 4, (b,)).astype(np.float32)
+        mod.forward(DataBatch([nd.array(x)], [nd.array(y)]),
+                    is_train=False)
+        return mod
+
+    # embed dim not divisible by heads: the einsum kernel's assert, not a
+    # shard_map reshape trace error
+    with pytest.raises(AssertionError, match="divisible by num_heads"):
+        build(e=10, heads=3, mesh_config=MeshConfig(data=2, seq=4))
+
+    # heads not divisible by the model axis: einsum fallback
+    PATH_TAKEN["last"] = None
+    build(e=12, heads=3, mesh_config=MeshConfig(data=1, seq=4, model=2))
+    assert PATH_TAKEN["last"] == "einsum", PATH_TAKEN
+
+
+def test_ring_flash_interpret_mode_warns():
+    """use_flash=True silently resolving to Pallas interpreter mode on a
+    non-TPU backend must warn; an explicit interpret=True (tests) or the
+    streaming path must not."""
+    import warnings
+
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel.compat import shard_map
+
+    b, t, e, heads = 1, 512, 128, 1
+    q = np.zeros((b, t, e), np.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+
+    def run(**kw):
+        ring = shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="seq",
+                                              num_heads=heads, **kw),
+            mesh=mesh, in_specs=(P(None, "seq", None),) * 3,
+            out_specs=P(None, "seq", None), check_vma=False)
+        np.asarray(jax.jit(ring)(q, q, q))
+
+    with pytest.warns(RuntimeWarning, match="interpreter mode"):
+        run(use_flash=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        run(use_flash=True, interpret=True)
+        run(use_flash=False)

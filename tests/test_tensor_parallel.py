@@ -283,7 +283,8 @@ def test_collective_stats_parsing():
     Layout-annotated tuples nest parens to depth 3 (`{1,0:T(8,128)}`);
     grouped async starts carry tuples of buffers; all-reduce-start's shape
     is a FLAT tuple of results (no operand-alias element) while
-    all-gather / collective-permute starts are (operands, results, ctx).
+    all-gather / reduce-scatter / collective-permute starts are
+    (operands, results, ctx).
     """
     from mxnet_tpu.parallel.hlo_stats import collective_stats
 
@@ -305,6 +306,20 @@ def test_collective_stats_parsing():
         "ROOT %r = (f32[1,100]{1,0}, f32[1,200]{1,0}) "
         "all-reduce(%p2, %p3), channel_id=1")
     assert s["all-reduce"]["bytes"] == 300 * 4
+
+    # reduce-scatter-start carries (operand, result) like all-gather-start:
+    # only the scattered RESULT is payload — the generic fallback used to
+    # sum operand+result and double-count absolute KiB/step
+    s = collective_stats(
+        "%rs = (f32[64,128]{1,0:T(8,128)}, f32[8,128]{1,0:T(8,128)}) "
+        "reduce-scatter-start(%x), dimensions={0}, to_apply=%sum")
+    assert s["reduce-scatter"] == {"count": 1, "bytes": 8 * 128 * 4}
+
+    # sync reduce-scatter: the instruction shape IS the result
+    s = collective_stats(
+        "%rs2 = f32[8,128]{1,0} reduce-scatter(%x), dimensions={0}, "
+        "to_apply=%sum")
+    assert s["reduce-scatter"] == {"count": 1, "bytes": 8 * 128 * 4}
 
     # collective-permute-start: operand alias + u32 context scalars excluded
     cp = ("%cp = (f32[8,128]{1,0}, f32[8,128]{1,0}, u32[], u32[]) "
