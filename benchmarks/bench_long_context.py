@@ -15,17 +15,36 @@ canonical mesh shapes of the ring×TP composition story:
                 over 'model' INSIDE the ring's shard_map region, each
                 model shard rotating only its own K/V slice.
 
+Ring meshes are measured under BOTH communication schedules —
+``serial`` (each hop's ppermute issued after the hop's kernel,
+``MXNET_RING_DOUBLE_BUFFER=0``) and ``overlapped`` (the double-buffered
+default: the K/V fetch for hop r+1, and the backward ring's traveling
+dK/dV rotation, issued before hop r's kernel) — so the overlap win is a
+measured row, not a claim.  Each run also reports the train step's
+collective traffic from compiled HLO (``parallel.hlo_stats``): total
+bytes plus the async-pair "overlappable" bytes (nonzero on backends
+that split collectives into start/done, i.e. TPU).
+
 Mirrors bench.py's contract: ONE json line on stdout —
 ``{"metric": "attention_lm_tokens_per_sec_t<T>", "value", "unit",
-"mfu", "vs_baseline"}`` — where the value is the ring×TP mesh rate and
-``vs_baseline`` is its speedup over the TP-only GSPMD einsum plan on the
-same chips.  Per-mesh detail (tokens/s, sustained TFLOP/s, MFU, traced
-attention path) goes to stderr, one json per mesh.
+"mfu", "vs_baseline", "vs_serial"}`` — where the value is the ring×TP
+mesh rate under the overlapped schedule, ``vs_baseline`` is its speedup
+over the TP-only GSPMD einsum plan on the same chips, and ``vs_serial``
+its speedup over its own serial schedule.  Per-(mesh, schedule) detail
+(tokens/s, sustained TFLOP/s, MFU, traced attention path, collective
+bytes) goes to stderr, one json per run.
 
 Env knobs: BENCH_T, BENCH_BATCH, BENCH_EMBED, BENCH_HEADS, BENCH_VOCAB,
-BENCH_ITERS, BENCH_DTYPE, BENCH_MESHES (comma-filter, e.g. "seq,ring_tp").
+BENCH_ITERS, BENCH_DTYPE, BENCH_MESHES (comma-filter, e.g. "seq,ring_tp"),
+BENCH_SCHEDULES (comma-filter, "serial,overlapped"), BENCH_HLO (force
+collective accounting on/off; default on except TPU, where the extra
+fwd+bwd lowering would recompile a T=8192 program just for byte counts).
 CPU runs shrink all dims and force an 8-virtual-device host platform so
 the meshes exist (same trick as tests/conftest.py).
+
+``--smoke``: the tier-1 CI entry — forces the 8-virtual-device CPU
+platform and tiny dims (T=64) so the JSON contract and both schedules
+stay runnable on every PR (tests/test_bench_contract.py invokes it).
 """
 import json
 import os
@@ -34,13 +53,24 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+SMOKE = "--smoke" in sys.argv
+
 # the virtual-device mesh must exist BEFORE jax initializes its backend
+if SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 if os.environ.get("JAX_PLATFORMS", "") == "cpu" and \
         "xla_force_host_platform_device_count" not in \
         os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8"
                                ).strip()
+if SMOKE:
+    # this image pre-imports jax with the TPU platform hook, so the env
+    # var alone can be read too late — pin the platform in code (same
+    # caveat as tests/conftest.py / docs/env_vars.md)
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -80,27 +110,41 @@ def main():
     import jax
 
     import mxnet_tpu as mx
+    from mxnet_tpu import config as _config
     from mxnet_tpu import ndarray as nd
     from mxnet_tpu import symbol as sym
     from mxnet_tpu.io import DataBatch, DataDesc
     from mxnet_tpu.ops.attention import PATH_TAKEN
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
 
     platform = jax.devices()[0].platform
     n_dev = len(jax.devices())
     on_tpu = platform == "tpu"
 
-    t = int(os.environ.get("BENCH_T", "8192" if on_tpu else "256"))
+    t = int(os.environ.get("BENCH_T",
+                           "64" if SMOKE else "8192" if on_tpu else "256"))
     b = int(os.environ.get("BENCH_BATCH", "2"))
-    e = int(os.environ.get("BENCH_EMBED", "2048" if on_tpu else "64"))
+    e = int(os.environ.get("BENCH_EMBED",
+                           "32" if SMOKE else "2048" if on_tpu else "64"))
     heads = int(os.environ.get("BENCH_HEADS", "16" if on_tpu else "4"))
-    vocab = int(os.environ.get("BENCH_VOCAB", "8192" if on_tpu else "64"))
-    n_iters = int(os.environ.get("BENCH_ITERS", "10" if on_tpu else "2"))
+    vocab = int(os.environ.get("BENCH_VOCAB",
+                               "32" if SMOKE else
+                               "8192" if on_tpu else "64"))
+    n_iters = int(os.environ.get("BENCH_ITERS",
+                                 "1" if SMOKE else "10" if on_tpu else "2"))
     dtype = os.environ.get("BENCH_DTYPE",
                            "bfloat16" if on_tpu else "float32")
     warmup = 3 if on_tpu else 1
+    # collective accounting lowers the fwd+bwd program once more — cheap
+    # on the CPU harness, a full recompile at TPU bench shapes, so it is
+    # on by default off-TPU only
+    want_hlo = _config._parse_bool(os.environ.get("BENCH_HLO",
+                                                  "0" if on_tpu else "1"))
 
     mesh_filter = [m for m in
                    os.environ.get("BENCH_MESHES", "").split(",") if m]
+    sched_filter = [s for s in
+                    os.environ.get("BENCH_SCHEDULES", "").split(",") if s]
 
     def build_lm():
         data = sym.Variable("data")
@@ -128,10 +172,7 @@ def main():
     train_flops_per_token = 3 * _flops_per_token(t, e, vocab)
     peak, kind = _bench._peak_for(jax.devices()[0])
 
-    results = {}
-    for name, cfg in _mesh_configs(n_dev).items():
-        if mesh_filter and name not in mesh_filter:
-            continue
+    def measure(cfg):
         mod = mx.mod.Module(build_lm(), context=contexts, mesh_config=cfg,
                             compute_dtype=dtype)
         data_desc = DataDesc("data", (b, t), layout="NT")
@@ -169,22 +210,80 @@ def main():
         tok_s = b * t * n_iters / dt
         tflops = tok_s * train_flops_per_token / 1e12
         mfu = tflops * 1e12 / (peak * n_dev) if peak else None
-        results[name] = {"tokens_per_sec": round(tok_s, 1),
-                         "sustained_tflops": round(tflops, 2),
-                         "mfu": round(mfu, 4) if mfu is not None else None,
-                         "attention_path": PATH_TAKEN["last"]}
-        print(json.dumps({"mesh": name, "mesh_shape": {
-            "data": cfg.data, "seq": cfg.seq, "model": cfg.model},
-            "device": kind, "dtype": dtype, "T": t, "batch": b,
-            **results[name]}), file=sys.stderr, flush=True)
+        row = {"tokens_per_sec": round(tok_s, 1),
+               "sustained_tflops": round(tflops, 2),
+               "mfu": round(mfu, 4) if mfu is not None else None,
+               "attention_path": PATH_TAKEN["last"]}
+        if want_hlo:
+            # collective accounting of the program that actually trained
+            # (same counting surface as the test-suite tripwires:
+            # parallel/hlo_stats)
+            if mod._fused_step is not None:
+                hlo = mod._fused_step.compiled_hlo(mod._exec_group)
+            else:
+                hlo = mod._exec_group.exec_.compiled_hlo()
+            if hlo is not None:
+                st = collective_stats(hlo)
+                row["collective_count"] = st["total"]["count"]
+                row["collective_bytes"] = st["total"]["bytes"]
+                row["overlappable_bytes"] = st["overlappable"]["bytes"]
+        return row
 
-    if not results:
-        sys.exit("no mesh measured: BENCH_MESHES=%r matched none of %s "
-                 "(ring_tp needs >= 4 devices; %d present)"
+    # the ring's communication schedule is env-selected at trace time:
+    # serial = MXNET_RING_DOUBLE_BUFFER=0, overlapped = 1 (the default).
+    # Meshes without a seq axis (tp) never trace a ring — one run.
+    results, results_serial = {}, {}
+    for name, cfg in _mesh_configs(n_dev).items():
+        if mesh_filter and name not in mesh_filter:
+            continue
+        schedules = ["overlapped", "serial"] if cfg.seq > 1 else [None]
+        for schedule in schedules:
+            if schedule and sched_filter and schedule not in sched_filter:
+                continue
+            prior = os.environ.get("MXNET_RING_DOUBLE_BUFFER")
+            if schedule:
+                os.environ["MXNET_RING_DOUBLE_BUFFER"] = \
+                    "1" if schedule == "overlapped" else "0"
+                _config.refresh("MXNET_RING_DOUBLE_BUFFER")
+            try:
+                row = measure(cfg)
+            finally:
+                if schedule:
+                    if prior is None:
+                        os.environ.pop("MXNET_RING_DOUBLE_BUFFER", None)
+                    else:
+                        os.environ["MXNET_RING_DOUBLE_BUFFER"] = prior
+                    _config.refresh("MXNET_RING_DOUBLE_BUFFER")
+            if schedule == "serial":
+                results_serial[name] = row
+            else:
+                results[name] = row
+            print(json.dumps({"mesh": name, "mesh_shape": {
+                "data": cfg.data, "seq": cfg.seq, "model": cfg.model},
+                "schedule": schedule or "n/a",
+                "device": kind, "dtype": dtype, "T": t, "batch": b,
+                **row}), file=sys.stderr, flush=True)
+
+    # a BENCH_SCHEDULES=serial run measures ring meshes into
+    # results_serial only — those are real measurements, so the headline
+    # pool merges them in (overlapped rows win for a mesh measured both
+    # ways) rather than erroring or letting a schedule-less mesh like tp
+    # shadow the ring rows the run was made to measure
+    pool = {**results_serial, **results}
+    if not pool:
+        sys.exit("no mesh measured: BENCH_MESHES=%r / BENCH_SCHEDULES=%r "
+                 "matched none of %s (ring_tp needs >= 4 devices; %d "
+                 "present)"
                  % (os.environ.get("BENCH_MESHES", ""),
+                    os.environ.get("BENCH_SCHEDULES", ""),
                     sorted(_mesh_configs(n_dev)), n_dev))
-    headline = results.get("ring_tp") or next(iter(results.values()))
+    head_name = "ring_tp" if "ring_tp" in pool else next(iter(pool))
+    headline = pool[head_name]
     base = results.get("tp")
+    # vs_serial only when the headline row itself is NOT the serial
+    # measurement (else it would read 1.0 by construction)
+    serial = (results_serial.get(head_name)
+              if head_name in results else None)
     print(json.dumps({
         "metric": "attention_lm_tokens_per_sec_t%d" % t,
         "value": headline["tokens_per_sec"],
@@ -193,6 +292,9 @@ def main():
         "vs_baseline": (round(headline["tokens_per_sec"]
                               / base["tokens_per_sec"], 3)
                         if base else None),
+        "vs_serial": (round(headline["tokens_per_sec"]
+                            / serial["tokens_per_sec"], 3)
+                      if serial else None),
     }))
 
 

@@ -13,6 +13,11 @@ dense attention's (B·H, T, T) logits and the streaming math's autodiff
 backward both exceed HBM; the kernel path is the only trainable one
 (benchmarks/ROOFLINE.md, round 5).
 
+The rings are double-buffered by default (each hop's K/V fetch issues
+before the hop's kernel, so TPU's async collective-permutes overlap the
+flash compute); MXNET_RING_DOUBLE_BUFFER=0 restores the serial schedule
+— bit-identical results either way (docs/long_context.md).
+
 Run (virtual 8-CPU mesh, interpreter-mode kernels):
     python examples/ring_attention_long_context.py
 On a real TPU mesh, drop the jax.config lines and interpret=None picks

@@ -125,6 +125,15 @@ register("MXNET_RING_ATTENTION", bool, True,
          "the per-hop compute is the Pallas flash kernel on TPU.  Set 0 "
          "to restore the GSPMD einsum path (the partitioner's all-gather "
          "plan) for A/B comparison.")
+register("MXNET_RING_DOUBLE_BUFFER", bool, True,
+         "Communication schedule for ring attention (parallel/ring.py): "
+         "1 (default) double-buffers the ring — each hop's K/V ppermute "
+         "(and the backward ring's traveling dK/dV rotation) is issued "
+         "BEFORE the hop's flash/streaming kernel, so backends with "
+         "async collectives (TPU: collective-permute-start/done) overlap "
+         "the wire time with compute.  0 restores the serial issue order "
+         "for A/B measurement (benchmarks/bench_long_context.py records "
+         "both).  Schedules are bit-identical in outputs and gradients.")
 register("MXNET_TP_MODE", str, "megatron",
          "Tensor-parallel sharding plan over the 'model' mesh axis: "
          "'megatron' (default) pairs column-parallel with row-parallel "

@@ -113,10 +113,16 @@ def register_all():
                 data_ax = "data" if mesh_axes.get("data", 1) > 1 else None
                 model_ax = "model" if model_par > 1 else None
                 spec = P(data_ax, "seq", model_ax)
+                # schedule knob threaded explicitly so the trace bakes in
+                # the CURRENT config value (the ring would otherwise read
+                # it lazily at trace time — same value, but the dispatch
+                # is where benchmarks A/B the schedules from)
+                dbuf = _config.get("MXNET_RING_DOUBLE_BUFFER")
                 ring = shard_map(
                     lambda q_, k_, v_: ring_attention(
                         q_, k_, v_, axis_name="seq", num_heads=heads,
-                        causal=causal, scale=scale, head_axis=model_ax),
+                        causal=causal, scale=scale, head_axis=model_ax,
+                        double_buffer=dbuf),
                     mesh=octx.mesh, in_specs=(spec,) * 3, out_specs=spec,
                     check_vma=False)
                 PATH_TAKEN["last"] = "ring"

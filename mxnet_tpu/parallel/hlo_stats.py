@@ -121,9 +121,15 @@ def collective_stats(hlo_text):
     """Count collectives and sum their result payloads.
 
     Async start/done pairs count once (the -start carries the shape).
-    Returns {op_name: {"count": int, "bytes": int}} plus "total" entry.
+    Returns {op_name: {"count": int, "bytes": int}} plus two aggregate
+    entries: "total" over every op, and "overlappable" — the count/bytes
+    of collectives the backend emitted as async ``-start``/``-done``
+    pairs, i.e. communication the scheduler can overlap with compute
+    between the pair (the double-buffered ring's collective-permutes on
+    TPU land here; backends that keep sync collectives report 0).
     """
     stats = {}
+    overlappable = {"count": 0, "bytes": 0}
     matches = []
     for line in hlo_text.splitlines():
         em = _INSTR_RE.search(line)
@@ -139,6 +145,8 @@ def collective_stats(hlo_text):
             continue
         if suffix == "-start":
             nbytes = _start_bytes(op, shape_s)
+            overlappable["count"] += 1
+            overlappable["bytes"] += nbytes
         else:
             nbytes = shape_bytes(shape_s)
         entry = stats.setdefault(op, {"count": 0, "bytes": 0})
@@ -147,4 +155,5 @@ def collective_stats(hlo_text):
     total = {"count": sum(e["count"] for e in stats.values()),
              "bytes": sum(e["bytes"] for e in stats.values())}
     stats["total"] = total
+    stats["overlappable"] = overlappable
     return stats

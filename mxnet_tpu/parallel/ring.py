@@ -27,6 +27,22 @@ along by additionally splitting the embed dim in the specs —
 global head count; each model shard then rotates only its own K/V slice.
 ``dot_product_attention``'s mesh dispatch (ops/attention.py) builds
 exactly this region.
+
+Schedules: ring attention's premise (Liu et al. 2023) is that the K/V
+rotation hides behind the per-hop attention compute.  The *serial*
+schedule (``double_buffer=False``) issues each hop's ppermute after the
+hop's kernel in program order; the *double-buffered* schedule (the
+default) issues the ppermute fetching hop r+1's K/V — and, in the
+backward ring, the traveling dK/dV accumulator rotation carrying hops
+<= r-1 — BEFORE invoking hop r's kernel on the already-resident buffer,
+so the collective has no data dependence on the hop's compute and XLA
+backends with async collectives (TPU: ``collective-permute-start`` /
+``-done`` pairs) overlap the wire time with the Pallas kernel.  Both
+schedules visit blocks in the same order and merge (m, l, acc) partials
+in the same sequence, so they are bit-identical — asserted in
+tests/test_seq_parallel.py.  The final hop's K/V rotation is elided in
+every ring (the rotated buffers would be discarded), so an n-hop ring
+moves n-1 K/V slices per tensor.
 """
 from __future__ import annotations
 
@@ -38,6 +54,12 @@ __all__ = ["ring_attention", "dense_attention"]
 # "streaming") — path-selection tripwire, same pattern as
 # ops.attention.PATH_TAKEN
 RING_PATH = {"last": None}
+
+# interpreter-mode warn-once latch: use_flash=True resolving to Pallas
+# interpreter mode warns once per PROCESS, not once per trace — jit
+# retraces (new shapes, new meshes) would otherwise repeat it dozens of
+# times per run.  Tests reset the latch to re-arm the warning.
+_INTERPRET_WARNED = {"done": False}
 
 
 def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
@@ -53,7 +75,7 @@ def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
 
 def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
                    scale=None, use_flash=None, interpret=None,
-                   head_axis=None):
+                   head_axis=None, double_buffer=None):
     """Blockwise ring attention over the ``axis_name`` mesh axis.
 
     Args are the LOCAL sequence blocks (B, T_local, E_local).  Device i
@@ -83,6 +105,15 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     streaming math otherwise.  ``use_flash`` forces the choice;
     ``interpret`` runs the kernels in interpreter mode (CPU tests).
 
+    ``double_buffer`` selects the communication schedule: True (the
+    default, via ``MXNET_RING_DOUBLE_BUFFER``) issues each hop's K/V
+    fetch — and the backward ring's traveling dK/dV rotation — before the
+    hop's kernel so async-collective backends overlap wire time with
+    compute; False restores the serial issue order for A/B measurement.
+    Both schedules are bit-identical (same block visit order, same
+    (m, l, acc) merge sequence) and both elide the final hop's discarded
+    K/V rotation.
+
     Measured on-chip (benchmarks/ROOFLINE.md round-5): flash wins fwd at
     every block size and fwd+bwd from T_local >= 4096 (1.3x), and is the
     ONLY trainable path at T_local = 8192 (the streaming backward's
@@ -91,11 +122,10 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     there if training short blocks on a wide mesh.
     """
     import jax
-    import jax.numpy as jnp
     from jax import lax
 
-    n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
+    from .. import config as _config
+
     b, t_local, e = q.shape
     if head_axis is not None:
         # head-group sharding: axis sizes are static, so psum(1, axis)
@@ -108,17 +138,20 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     hd = e // num_heads
     ev = v.shape[2] // num_heads
     scale = scale or 1.0 / np.sqrt(hd)
+    if double_buffer is None:
+        double_buffer = _config.get("MXNET_RING_DOUBLE_BUFFER")
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-        if use_flash and interpret:
+        if use_flash and interpret and not _INTERPRET_WARNED["done"]:
             # use_flash=True on a non-TPU backend silently resolves to
             # Pallas interpreter mode — every ring hop runs orders of
             # magnitude slower than the compiled kernel.  Tests opt in
             # with an explicit interpret=True; anything else should hear
-            # about it.
+            # about it (once per process — see _INTERPRET_WARNED).
             import warnings
 
+            _INTERPRET_WARNED["done"] = True
             warnings.warn(
                 "ring_attention(use_flash=True) on the %r backend resolves"
                 " to Pallas interpreter mode (orders of magnitude slower "
@@ -139,12 +172,33 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
     if use_flash:
         RING_PATH["last"] = "flash"
         return _ring_flash_fn(axis_name, bool(causal), float(scale),
-                              bool(interpret), num_heads)(q, k, v)
+                              bool(interpret), num_heads,
+                              bool(double_buffer))(q, k, v)
     RING_PATH["last"] = "streaming"
 
     qh = q.reshape(b, t_local, num_heads, hd) * scale
     kh = k.reshape(b, t_local, num_heads, hd)
     vh = v.reshape(b, t_local, num_heads, ev)
+    out = _ring_stream(qh, kh, vh, axis_name, causal, double_buffer)
+    return out.astype(v.dtype).reshape(b, t_local, v.shape[2])
+
+
+def _ring_stream(qh, kh, vh, axis_name, causal, double_buffer):
+    """The jnp streaming ring: per-hop blockwise attention with a running
+    (max, sum, acc) flash merge, differentiable by plain autodiff.
+
+    Inputs are head-split (B, T_local, H, hd/ev) with ``qh`` pre-scaled;
+    returns the normalized (B, T_local, H, ev) float32 output.  The hop
+    loop is unrolled (n is a static mesh size), with the communication
+    schedule chosen by ``double_buffer`` — see ``ring_attention``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, num_heads, _ = qh.shape
+    ev = vh.shape[3]
 
     # flash-attention accumulator state in fp32 (bf16-safe streaming sums)
     neg_inf = jnp.finfo(jnp.float32).min
@@ -154,8 +208,19 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def rotate(kb, vb):
+        return (lax.ppermute(kb, axis_name, perm),
+                lax.ppermute(vb, axis_name, perm))
+
     def step(carry, r):
         m, l, acc, kb, vb = carry
+        last = r == n - 1
+        # double-buffered: kick off the fetch of hop r+1's K/V before this
+        # hop's kernel touches the resident buffer — the ppermute depends
+        # only on kb/vb, never on the hop's compute, so async backends
+        # overlap it.  The final hop's rotation is elided either way (the
+        # rotated buffers would be discarded).
+        nxt = rotate(kb, vb) if double_buffer and not last else None
         # the K/V block currently held started at device (idx - r) mod n
         src = (idx - r) % n
         logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kb).astype(jnp.float32)
@@ -175,8 +240,8 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
         new_l = l * correction + p.sum(-1)
         new_acc = acc * correction.transpose(0, 2, 1)[..., None] + \
             jnp.einsum("bhqk,bkhe->bqhe", p, vb.astype(jnp.float32))
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
+        if not last:
+            kb, vb = rotate(kb, vb) if nxt is None else nxt
         return (new_m, new_l, new_acc, kb, vb), None
 
     carry = (m0, l0, acc0, kh, vh)
@@ -184,14 +249,14 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
         carry, _ = step(carry, r)
     m, l, acc, _, _ = carry
     denom = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / denom.transpose(0, 2, 1)[..., None]).astype(v.dtype)
-    return out.reshape(b, t_local, v.shape[2])
+    return acc / denom.transpose(0, 2, 1)[..., None]
 
 
 _RING_FLASH_CACHE = {}
 
 
-def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
+def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
+                   double_buffer):
     """custom_vjp-wrapped flash ring: forward runs a ring of forward flash
     kernels whose per-block (out, lse) partials merge with logsumexp
     weights; backward runs a second ring of the backward kernels using the
@@ -200,13 +265,21 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
     block's gradient arrives home after n hops.  Per hop, ``lax.switch``
     picks full / causal-diagonal / skip compute from the block's global
     offset — the causal skip saves the same ~2x the kernel's internal
-    block skipping does, one ring-hop coarser."""
-    key = (axis_name, causal, scale, interpret, num_heads)
+    block skipping does, one ring-hop coarser.
+
+    ``double_buffer`` reorders the communication issue only (see
+    ``ring_attention``): forward prefetches hop r+1's K/V before hop r's
+    kernel; backward additionally folds hop r-1's dK/dV contribution and
+    rotates the traveling accumulators at the START of iteration r, so
+    the rotation depends on the previous hop's kernel, not the current
+    one — the only dataflow ordering under which XLA can overlap the
+    accumulator wire time.  Contribution r is still folded before
+    rotation r+1 and rotated exactly n-r times, so serial and
+    double-buffered gradients are bit-identical."""
+    key = (axis_name, causal, scale, interpret, num_heads, double_buffer)
     hit = _RING_FLASH_CACHE.get(key)
     if hit is not None:
         return hit
-
-    import functools
 
     import jax
     import jax.numpy as jnp
@@ -234,6 +307,10 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
         perm = [(i, (i + 1) % n) for i in range(n)]
         neg_inf = jnp.float32(-jnp.inf)
 
+        def rotate(kk, vv):
+            return (lax.ppermute(kk, axis_name, perm),
+                    lax.ppermute(vv, axis_name, perm))
+
         def full_blk(args):
             qq, kk, vv = args
             ob, lb = pa._fwd_call(qq, kk, vv, scale, False, interpret,
@@ -256,6 +333,10 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
         l_w = jnp.zeros((bh, tl), jnp.float32)
         m = jnp.full((bh, tl), neg_inf, jnp.float32)
         for r in range(n):
+            last = r == n - 1
+            # prefetch hop r+1's K/V before this hop's kernel (final hop
+            # elided — the rotated buffers would be discarded)
+            nxt = rotate(kb, vb) if double_buffer and not last else None
             src = (idx - r) % n
             if causal:
                 case = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
@@ -270,8 +351,8 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
             o_w = o_w * c[..., None] + ob * cb[..., None]
             l_w = l_w * c + cb
             m = m_new
-            kb = lax.ppermute(kb, axis_name, perm)
-            vb = lax.ppermute(vb, axis_name, perm)
+            if not last:
+                kb, vb = rotate(kb, vb) if nxt is None else nxt
         denom = jnp.where(l_w == 0.0, 1.0, l_w)
         of = (o_w / denom[..., None])
         lse = jnp.where(l_w == 0.0, neg_inf, m + jnp.log(denom))
@@ -302,6 +383,10 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
         lse3 = jnp.broadcast_to(lse[..., None], (bh, tl, pa.LANES))
         perm = [(i, (i + 1) % n) for i in range(n)]
 
+        def rotate(kk, vv):
+            return (lax.ppermute(kk, axis_name, perm),
+                    lax.ppermute(vv, axis_name, perm))
+
         def full_blk(args):
             qq, kk, vv = args
             dq_b, dk_b, dv_b = pa._bwd_call(qq, kk, vv, ofd, lse3, dof,
@@ -320,26 +405,53 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads):
             z = jnp.zeros((bh, tl, hd), jnp.float32)
             return z, z, z
 
-        dq = jnp.zeros((bh, tl, hd), jnp.float32)
-        dkb = jnp.zeros((bh, tl, hd), jnp.float32)
-        dvb = jnp.zeros((bh, tl, hd), jnp.float32)
-        for r in range(n):
+        def hop(r):
             src = (idx - r) % n
             if causal:
                 case = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
-                dq_b, dk_b, dv_b = lax.switch(
-                    case, [full_blk, diag_blk, skip_blk], (qf, kb, vb))
-            else:
-                dq_b, dk_b, dv_b = full_blk((qf, kb, vb))
-            dq = dq + dq_b
-            dkb = dkb + dk_b
-            dvb = dvb + dv_b
-            # gradient accumulators travel WITH their K/V blocks; after n
-            # rotations each block's gradient is back at its owner
-            kb = lax.ppermute(kb, axis_name, perm)
-            vb = lax.ppermute(vb, axis_name, perm)
-            dkb = lax.ppermute(dkb, axis_name, perm)
-            dvb = lax.ppermute(dvb, axis_name, perm)
+                return lax.switch(case, [full_blk, diag_blk, skip_blk],
+                                  (qf, kb, vb))
+            return full_blk((qf, kb, vb))
+
+        dq = jnp.zeros((bh, tl, hd), jnp.float32)
+        dkb = jnp.zeros((bh, tl, hd), jnp.float32)
+        dvb = jnp.zeros((bh, tl, hd), jnp.float32)
+        if double_buffer:
+            # gradient accumulators travel WITH their K/V blocks, but hop
+            # r's contribution need not leave until rotation r+1 — so fold
+            # hop r-1's pending contribution and rotate the accumulators
+            # at the START of iteration r, before this hop's kernel: the
+            # rotation's only dependence is the PREVIOUS kernel, and the
+            # wire time overlaps the current one.  Each contribution is
+            # still rotated exactly n - r times, arriving home with its
+            # block after the final fold+rotate below.
+            dk_pend = dv_pend = None
+            for r in range(n):
+                last = r == n - 1
+                if r > 0:
+                    dkb = lax.ppermute(dkb + dk_pend, axis_name, perm)
+                    dvb = lax.ppermute(dvb + dv_pend, axis_name, perm)
+                nxt = rotate(kb, vb) if not last else None
+                dq_b, dk_pend, dv_pend = hop(r)
+                dq = dq + dq_b
+                if not last:
+                    kb, vb = nxt
+            dkb = lax.ppermute(dkb + dk_pend, axis_name, perm)
+            dvb = lax.ppermute(dvb + dv_pend, axis_name, perm)
+        else:
+            for r in range(n):
+                last = r == n - 1
+                dq_b, dk_b, dv_b = hop(r)
+                dq = dq + dq_b
+                dkb = dkb + dk_b
+                dvb = dvb + dv_b
+                # gradient accumulators travel WITH their K/V blocks; after
+                # n rotations each block's gradient is back at its owner.
+                # K/V's own final rotation is elided (discarded buffers).
+                dkb = lax.ppermute(dkb, axis_name, perm)
+                dvb = lax.ppermute(dvb, axis_name, perm)
+                if not last:
+                    kb, vb = rotate(kb, vb)
         dq_out = unfold(dq, b, tl, num_heads, hd).astype(q.dtype)
         dk_out = unfold(dkb, b, tl, num_heads, hd).astype(k.dtype)
         dv_out = unfold(dvb, b, tl, num_heads, hd).astype(v.dtype)

@@ -253,6 +253,55 @@ class CompiledTrainStep:
         self.num_steps += 1
         return outs
 
+    def compiled_hlo(self, group=None):
+        """Optimized-HLO text of the fused train-step program (None before
+        the first ``run``).
+
+        Same probe surface as ``Executor.compiled_hlo`` — feed it to
+        ``parallel.hlo_stats.collective_stats`` — but over the program
+        that actually trains: forward + backward + optimizer in the one
+        donated jit.  Avals (+shardings) are rebuilt from the live master
+        store and the executor's bound input buffers, so nothing extra is
+        retained on the hot path; the lowering compiles a throwaway copy
+        of the program (cached jit executables are keyed by concrete
+        arrays, not avals), so this is a probe, not a free read.
+        """
+        import jax
+
+        from . import random as _rnd
+
+        group = group if group is not None else self._group
+        if self._hyper_cache is None:
+            return None  # never run: no hyper avals to rebuild
+        fn = self._entry_for(group)
+
+        def _aval(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+
+        params = {n: _aval(v) for n, v in self.params.items()}
+        slots = {n: tuple(_aval(s) for s in v)
+                 for n, v in self.slots.items()}
+        aux = {n: _aval(v) for n, v in self.aux.items()}
+        exe = group.exec_
+        label_names = [n for n in group.label_names if n in exe.arg_dict]
+        data = {}
+        for name in list(group.data_names) + label_names:
+            v = exe.arg_dict[name].data
+            if group._mesh is not None:
+                sharding = group._input_sharding(name)
+            else:
+                sharding = v.sharding
+            data[name] = jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                              sharding=sharding)
+        lrs, wds, rescale, clip, extra = map(_aval, self._hyper_cache[5])
+        # peek the key chain for its aval — a probe must not advance the
+        # global RNG (split_key() here would shift every later step's
+        # randomness and break bit-reproducibility around the probe)
+        rng = _aval(_rnd._key())
+        return fn.lower(params, slots, aux, data, lrs, wds, rescale, clip,
+                        extra, rng).compile().as_text()
+
     def _place(self, arr, name, group=None):
         import jax
 

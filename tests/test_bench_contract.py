@@ -1,0 +1,59 @@
+"""Tier-1 smoke run of the long-context benchmark.
+
+`benchmarks/bench_long_context.py --smoke` (tiny T, 8 virtual CPU
+devices) must stay importable and runnable on every PR: one JSON line on
+stdout under the bench.py contract, per-(mesh, schedule) detail JSONs on
+stderr covering BOTH ring communication schedules (serial and
+double-buffered), with collective traffic accounted from compiled HLO.
+A broken bench would otherwise only surface on the TPU rig.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_long_context_smoke_contract():
+    env = dict(os.environ)
+    # the bench pins the platform itself under --smoke; scrub any
+    # conflicting parent flags so the virtual mesh is its own, and any
+    # inherited bench/schedule knobs (a developer's exported BENCH_T or
+    # BENCH_MESHES would override the smoke dims and coverage)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_RING_DOUBLE_BUFFER", None)
+    for key in [k for k in env if k.startswith("BENCH_")]:
+        env.pop(key)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "bench_long_context.py"), "--smoke"],
+        capture_output=True, text=True, timeout=420, cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    # stdout: exactly one JSON line, the bench.py metric contract
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    head = json.loads(lines[0])
+    assert head["metric"].startswith("attention_lm_tokens_per_sec_t")
+    assert head["unit"] == "tok/s"
+    assert head["value"] > 0
+    for key in ("mfu", "vs_baseline", "vs_serial"):
+        assert key in head, head
+    assert head["vs_baseline"] > 0 and head["vs_serial"] > 0
+
+    # stderr: one JSON per (mesh, schedule); both ring schedules must
+    # have run, the ring path must have been traced, and the collective
+    # accounting must show schedule-identical traffic
+    rows = [json.loads(ln) for ln in proc.stderr.splitlines()
+            if ln.strip().startswith("{")]
+    by_key = {(r["mesh"], r["schedule"]): r for r in rows}
+    for mesh in ("seq", "ring_tp"):
+        for schedule in ("overlapped", "serial"):
+            assert (mesh, schedule) in by_key, sorted(by_key)
+            assert by_key[(mesh, schedule)]["attention_path"] == "ring"
+        over = by_key[(mesh, "overlapped")]
+        assert over["collective_count"] > 0
+        assert over["collective_bytes"] == \
+            by_key[(mesh, "serial")]["collective_bytes"]
+    assert by_key[("tp", "n/a")]["attention_path"] == "einsum"

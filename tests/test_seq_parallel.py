@@ -741,30 +741,283 @@ def test_ring_dispatch_rejects_malformed_head_configs():
 
 def test_ring_flash_interpret_mode_warns():
     """use_flash=True silently resolving to Pallas interpreter mode on a
-    non-TPU backend must warn; an explicit interpret=True (tests) or the
-    streaming path must not."""
+    non-TPU backend must warn — ONCE per process, not once per
+    trace/retrace; an explicit interpret=True (tests) or the streaming
+    path must never warn."""
     import warnings
 
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
 
     from mxnet_tpu.parallel.compat import shard_map
+    from mxnet_tpu.parallel.ring import _INTERPRET_WARNED
 
     b, t, e, heads = 1, 512, 128, 1
     q = np.zeros((b, t, e), np.float32)
     mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
 
-    def run(**kw):
+    def run(tl=t, **kw):
+        qq = np.zeros((b, tl, e), np.float32)
         ring = shard_map(
             lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="seq",
                                               num_heads=heads, **kw),
             mesh=mesh, in_specs=(P(None, "seq", None),) * 3,
             out_specs=P(None, "seq", None), check_vma=False)
-        np.asarray(jax.jit(ring)(q, q, q))
+        np.asarray(jax.jit(ring)(qq, qq, qq))
 
-    with pytest.warns(RuntimeWarning, match="interpreter mode"):
-        run(use_flash=True)
-    with warnings.catch_warnings():
-        warnings.simplefilter("error")
-        run(use_flash=True, interpret=True)
-        run(use_flash=False)
+    _INTERPRET_WARNED["done"] = False  # re-arm: an earlier test may have
+    try:                               # already burned the process latch
+        with pytest.warns(RuntimeWarning, match="interpreter mode"):
+            run(use_flash=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            # a RETRACE (new shape) of the same hazard must not warn again
+            run(tl=256, use_flash=True)
+        # explicit interpret=True / the streaming path never warn — even
+        # with the latch re-armed
+        _INTERPRET_WARNED["done"] = False
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            run(use_flash=True, interpret=True)
+            run(use_flash=False)
+        assert not _INTERPRET_WARNED["done"]
+    finally:
+        _INTERPRET_WARNED["done"] = False
+
+
+# ---------------------------------------------------------------------------
+# double-buffered ring schedule: the ppermute fetching hop r+1's K/V (and
+# the backward ring's traveling dK/dV rotation) issues BEFORE hop r's
+# kernel, so async-collective backends overlap wire time with compute.
+# Schedules must be bit-identical, and the forward rings must elide the
+# final hop's discarded K/V rotation.
+# ---------------------------------------------------------------------------
+def _ring_222(db, causal, heads=4, **kw):
+    """The (data=2, seq=2, model=2) head-sharded ring as a jitted fn."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "seq", "model"))
+    spec = P("data", "seq", "model")
+    return jax.jit(shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="seq",
+                                          num_heads=heads, causal=causal,
+                                          head_axis="model",
+                                          double_buffer=db, **kw),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_double_buffer_bit_identical_streaming(causal):
+    """Serial vs double-buffered streaming ring on the (2,2,2) mesh:
+    outputs AND gradients bit-identical (same block visit order, same
+    (m, l, acc) merge sequence — the schedules differ only in when the
+    collectives are issued)."""
+    import jax
+
+    rng = np.random.RandomState(20)
+    b, t, e, heads = 2, 16, 16, 4
+    q, k, v = [rng.normal(size=(b, t, e)).astype(np.float32)
+               for _ in range(3)]
+
+    o_db = np.asarray(_ring_222(True, causal)(q, k, v))
+    o_se = np.asarray(_ring_222(False, causal)(q, k, v))
+    assert np.array_equal(o_db, o_se)
+    # sanity: still the right numbers, not just consistently wrong ones
+    ref = np.asarray(dense_attention(q, k, v, num_heads=heads,
+                                     causal=causal))
+    assert_almost_equal(o_db, ref, rtol=1e-4, atol=1e-5)
+
+    def loss(f):
+        return lambda q_, k_, v_: (f(q_, k_, v_) ** 2).sum()
+
+    g_db = jax.jit(jax.grad(loss(_ring_222(True, causal)),
+                            argnums=(0, 1, 2)))(q, k, v)
+    g_se = jax.jit(jax.grad(loss(_ring_222(False, causal)),
+                            argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b_ in zip("qkv", g_db, g_se):
+        assert np.array_equal(np.asarray(a), np.asarray(b_)), "d" + name
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_double_buffer_bit_identical_flash(causal):
+    """Serial vs double-buffered flash ring on the (2,2,2) mesh: the
+    custom-VJP backward's lag-by-one dK/dV rotation folds hop r-1's
+    contribution before rotation r — same adds, same rotations, so
+    gradients are bit-identical to the serial schedule."""
+    import jax
+
+    rng = np.random.RandomState(21)
+    b, t, e, heads = 2, 256, 256, 2
+    q, k, v = [rng.normal(size=(b, t, e)).astype(np.float32)
+               for _ in range(3)]
+    kw = dict(heads=2, use_flash=True, interpret=True)
+
+    from mxnet_tpu.parallel.ring import RING_PATH
+
+    RING_PATH["last"] = None
+    o_db = np.asarray(_ring_222(True, causal, **kw)(q, k, v))
+    assert RING_PATH["last"] == "flash"
+    o_se = np.asarray(_ring_222(False, causal, **kw)(q, k, v))
+    assert np.array_equal(o_db, o_se)
+    ref = np.asarray(dense_attention(q, k, v, num_heads=2, causal=causal))
+    assert_almost_equal(o_db, ref, rtol=1e-4, atol=1e-5)
+
+    def loss(f):
+        return lambda q_, k_, v_: (f(q_, k_, v_) ** 2).sum()
+
+    g_db = jax.jit(jax.grad(loss(_ring_222(True, causal, **kw)),
+                            argnums=(0, 1, 2)))(q, k, v)
+    g_se = jax.jit(jax.grad(loss(_ring_222(False, causal, **kw)),
+                            argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b_ in zip("qkv", g_db, g_se):
+        assert np.array_equal(np.asarray(a), np.asarray(b_)), "d" + name
+
+
+def test_ring_double_buffer_schedule_tripwire():
+    """PATH_TAKEN-style schedule tripwires, asserted at the layer each
+    backend can express:
+
+    * jaxpr equation order (what this code controls, any backend): under
+      double_buffer=True every forward ring issues its ppermute BEFORE
+      the hop's kernel; serial issues it after.
+    * rotation counts: an n-hop forward ring moves exactly 2*(n-1) K/V
+      slices (final hop elided); the flash VJP adds 2*(n-1) K/V + 2*n
+      traveling dK/dV rotations in the backward ring.
+    * compiled HLO: both schedules move identical collective-permute
+      count/bytes, and when the backend splits collectives into async
+      pairs (TPU), every start has its done and hlo_stats reports them
+      as overlappable bytes; XLA:CPU keeps sync collective-permute, so
+      there the overlappable statistic must be exactly 0 (that is the
+      documented CPU limitation, not a schedule regression).
+    """
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel.compat import shard_map
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
+
+    n = 4
+    b, t, e, heads = 1, 16 * n, 8, 2
+    x = np.zeros((b, t, e), np.float32)
+    mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+    def ring(db, **kw):
+        return shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="seq",
+                                              num_heads=heads, causal=False,
+                                              double_buffer=db, **kw),
+            mesh=mesh, in_specs=(P(None, "seq", None),) * 3,
+            out_specs=P(None, "seq", None), check_vma=False)
+
+    # jaxpr order: streaming kernel = the einsum dot_general
+    jx_db = str(jax.make_jaxpr(ring(True))(x, x, x))
+    jx_se = str(jax.make_jaxpr(ring(False))(x, x, x))
+    assert jx_db.count("ppermute") == 2 * (n - 1), jx_db.count("ppermute")
+    assert jx_se.count("ppermute") == 2 * (n - 1)
+    assert jx_db.index("ppermute") < jx_db.index("dot_general")
+    assert jx_se.index("ppermute") > jx_se.index("dot_general")
+
+    # flash ring (interpreter kernels): same ordering around pallas_call,
+    # and the backward ring's rotation budget — fwd 2*(n-1) inside
+    # rf_fwd, plus bwd 2*(n-1) K/V and 2*n traveling dK/dV
+    tf, ef = 128 * n, 128
+    xf = np.zeros((b, tf, ef), np.float32)
+
+    def fgrad(db):
+        f = ring(db, use_flash=True, interpret=True)
+        return jax.grad(lambda *a: (f(*a) ** 2).sum(), argnums=(0, 1, 2))
+
+    jf_db = str(jax.make_jaxpr(ring(True, use_flash=True,
+                                    interpret=True))(xf, xf, xf))
+    assert jf_db.count("ppermute") == 2 * (n - 1)
+    assert jf_db.index("ppermute") < jf_db.index("pallas_call")
+    jg_db = str(jax.make_jaxpr(fgrad(True))(xf, xf, xf))
+    jg_se = str(jax.make_jaxpr(fgrad(False))(xf, xf, xf))
+    expect = 2 * (n - 1) + 2 * (n - 1) + 2 * n
+    assert jg_db.count("ppermute") == expect, jg_db.count("ppermute")
+    assert jg_se.count("ppermute") == expect
+
+    # compiled HLO: schedules are traffic-identical; async pairs (when
+    # the backend emits them) are recognized once and totalled as
+    # overlappable bytes
+    for db in (True, False):
+        hlo = jax.jit(ring(db)).lower(x, x, x).compile().as_text()
+        st = collective_stats(hlo)
+        cp = st.get("collective-permute")
+        assert cp is not None and cp["count"] == 2 * (n - 1), st
+        starts = hlo.count(" collective-permute-start(")
+        dones = hlo.count(" collective-permute-done(")
+        assert starts == dones
+        if starts:  # async-collective backend (TPU)
+            assert st["overlappable"]["count"] == starts
+            assert st["overlappable"]["bytes"] > 0
+        else:       # XLA:CPU keeps sync collective-permute
+            assert st["overlappable"] == {"count": 0, "bytes": 0}
+
+
+def test_module_ring_double_buffer_train_step(monkeypatch):
+    """The knob threads through the op dispatch: Module train steps on the
+    (2,2,2) mesh under MXNET_RING_DOUBLE_BUFFER=0/1 take the ring path
+    both ways, produce bit-identical outputs and gradients, and move the
+    identical collective traffic (the schedules differ in issue order,
+    never in bytes)."""
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.ops.attention import PATH_TAKEN
+    from mxnet_tpu.parallel.hlo_stats import collective_stats
+
+    b, t, e, heads = 4, 16, 16, 4
+    rng = np.random.RandomState(22)
+    x = rng.normal(size=(b, t, e)).astype(np.float32)
+    y = rng.randint(0, 4, (b,)).astype(np.float32)
+
+    def step(dbuf):
+        monkeypatch.setenv("MXNET_RING_DOUBLE_BUFFER", dbuf)
+        _config.refresh("MXNET_RING_DOUBLE_BUFFER")
+        try:
+            data = sym.Variable("data")
+            q = sym.FullyConnected(data, num_hidden=e, flatten=False,
+                                   name="q")
+            k = sym.FullyConnected(data, num_hidden=e, flatten=False,
+                                   name="k")
+            v = sym.FullyConnected(data, num_hidden=e, flatten=False,
+                                   name="v")
+            att = sym.dot_product_attention(q, k, v, num_heads=heads,
+                                            causal=True)
+            net = sym.FullyConnected(att, num_hidden=4, name="head")
+            net = sym.SoftmaxOutput(net, name="softmax")
+            mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)],
+                                mesh_config=MeshConfig(data=2, seq=2,
+                                                       model=2))
+            mod.bind(data_shapes=[DataDesc("data", (b, t, e),
+                                           layout="NTC")],
+                     label_shapes=[("softmax_label", (b,))])
+            np.random.seed(23)  # identical params under both schedules
+            mod.init_params(mx.initializer.Xavier())
+            PATH_TAKEN["last"] = None
+            mod.forward(DataBatch([nd.array(x)], [nd.array(y)]),
+                        is_train=True)
+            assert PATH_TAKEN["last"] == "ring", PATH_TAKEN
+            mod.backward()
+            out = mod.get_outputs()[0].asnumpy()
+            grads = [g.asnumpy() for g in mod._exec_group.grad_arrays
+                     if g is not None]
+            hlo = mod._exec_group.exec_.compiled_hlo()
+        finally:
+            _config.refresh("MXNET_RING_DOUBLE_BUFFER")
+        return out, grads, hlo
+
+    out_db, grads_db, hlo_db = step("1")
+    out_se, grads_se, hlo_se = step("0")
+    assert np.array_equal(out_db, out_se)
+    for g_db, g_se in zip(grads_db, grads_se):
+        assert np.array_equal(g_db, g_se)
+    st_db = collective_stats(hlo_db)
+    st_se = collective_stats(hlo_se)
+    cp_db = st_db.get("collective-permute")
+    assert cp_db is not None and cp_db["count"] > 0, st_db
+    assert cp_db == st_se.get("collective-permute"), (st_db, st_se)
+    assert st_db["total"]["bytes"] == st_se["total"]["bytes"]

@@ -331,3 +331,13 @@ def test_collective_stats_parsing():
     s = collective_stats(
         cp + "\n%cpd = f32[8,128]{1,0} collective-permute-done(%cp)")
     assert s["collective-permute"]["count"] == 1
+
+    # async -start pairs are the "overlappable" statistic (communication
+    # the scheduler can hide between start and done); sync collectives
+    # contribute to total but never to overlappable
+    assert s["overlappable"] == {"count": 1, "bytes": 8 * 128 * 4}
+    s = collective_stats(
+        "%cp2 = f32[8,128]{1,0} collective-permute(%x), "
+        "source_target_pairs={{0,1}}")
+    assert s["overlappable"] == {"count": 0, "bytes": 0}
+    assert s["total"]["count"] == 1

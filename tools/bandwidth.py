@@ -121,10 +121,12 @@ def model_step_report(n_model):
         for mode in ("megatron", "naive"):
             st = step_stats(case, mode)
             print("%-13s TP plan %-9s: %3d collectives, %8.1f KiB/step "
-                  "moved" % (label, mode, st["total"]["count"],
-                             st["total"]["bytes"] / 1024), flush=True)
+                  "moved (%.1f KiB async-overlappable)"
+                  % (label, mode, st["total"]["count"],
+                     st["total"]["bytes"] / 1024,
+                     st["overlappable"]["bytes"] / 1024), flush=True)
             for op, e in sorted(st.items()):
-                if op != "total":
+                if op not in ("total", "overlappable"):
                     print("    %-19s x%-3d %8.1f KiB" %
                           (op, e["count"], e["bytes"] / 1024), flush=True)
 
