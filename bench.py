@@ -8,8 +8,14 @@ full training step: forward + backward + SGD-momentum update, compiled as
 ONE donated XLA program (bf16 compute, fp32 master weights) — see
 mxnet_tpu/train_step.py.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"} plus
-sustained TFLOP/s and MFU on stderr.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"} plus the
+async-loop accounting fields {"input_stall_fraction", "host_syncs_per_step"}
+(profiler.step_stats); sustained TFLOP/s and MFU go to stderr.
+
+``--smoke``: tiny-MLP fit through the FULL async training loop (device-side
+metrics + device prefetch + bounded in-flight dispatch) on the CPU harness —
+the tier-1 hook that keeps the loop-accounting contract honest
+(tests/test_bench_contract.py).
 """
 import json
 import os
@@ -175,17 +181,27 @@ def main():
             src = mod._exec_group.param_arrays[-1].data
         return float(jnp.sum(src.astype(jnp.float32)))
 
+    from mxnet_tpu import profiler
+
     for _ in range(warmup):
         mod.forward_backward(next(batch_stream))
         mod.update()
     sync()
 
+    profiler.reset_step_stats()
     tic = time.time()
     for _ in range(n_iters):
-        mod.forward_backward(next(batch_stream))
+        t0 = time.perf_counter()
+        batch = next(batch_stream)
+        profiler.record_input_wait(time.perf_counter() - t0)
+        mod.forward_backward(batch)
         mod.update()
+        profiler.record_step()
+    t0 = time.perf_counter()
     sync()
+    profiler.record_host_wait(time.perf_counter() - t0)
     toc = time.time()
+    stats = profiler.step_stats()
 
     img_s = batch_size * n_iters / (toc - tic)
     tflops = img_s * TRAIN_FLOPS_PER_IMG / 1e12
@@ -204,8 +220,61 @@ def main():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "input_stall_fraction": round(stats["input_stall_fraction"], 4),
+        "host_syncs_per_step": round(stats["host_syncs_per_step"], 4),
+    }))
+
+
+def smoke():
+    """Tier-1 smoke: a small MLP fit on the CPU harness through the full
+    async loop (device metrics, device prefetch, bounded in-flight
+    dispatch), reporting the loop-accounting contract fields."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    batch, steps_per_epoch, epochs = 32, 25, 2
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (batch * steps_per_epoch, 64)).astype(np.float32)
+    y = rng.randint(0, 8, (batch * steps_per_epoch,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    profiler.reset_step_stats()
+    tic = time.time()
+    mod.fit(it, eval_metric="acc", num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    toc = time.time()
+    stats = profiler.step_stats()
+    if mod._fused_step is None:
+        print("WARNING: fused train step not active", file=sys.stderr)
+    print(json.dumps({"loop_stats": {k: stats[k] for k in
+                                     ("steps", "host_wait_s", "input_wait_s",
+                                      "metric_d2h", "metric_syncs")}}),
+          file=sys.stderr)
+    n = max(stats["steps"], 1)
+    print(json.dumps({
+        "metric": "async_fit_mlp_imgs_per_sec_bs%d" % batch,
+        "value": round(batch * n / (toc - tic), 2),
+        "unit": "img/s",
+        "vs_baseline": 1.0,
+        "input_stall_fraction": round(stats["input_stall_fraction"], 4),
+        "host_syncs_per_step": round(stats["host_syncs_per_step"], 4),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv:
+        smoke()
+    else:
+        main()

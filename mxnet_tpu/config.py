@@ -142,6 +142,35 @@ register("MXNET_TP_MODE", str, "megatron",
          "round-3 blanket dim-0 sharding (for A/B comparison — "
          "tests/test_tensor_parallel.py measures the collective-count "
          "difference from compiled HLO).")
+register("MXNET_METRIC_SYNC_PERIOD", int, 0,
+         "With device-side metric accumulation active, pull the metric "
+         "accumulators to the host every N training steps.  0 (default) "
+         "syncs only at natural boundaries (epoch end, or whenever a "
+         "callback reads the metric), eliminating the per-step "
+         "device->host round trip of the classic loop.")
+register("MXNET_DEVICE_METRICS", bool, True,
+         "Fold loss/accuracy accumulation into the donated train-step "
+         "program as extra donated state for metrics that implement the "
+         "device protocol (metric.py device_batch).  The training loop "
+         "then never materializes per-step outputs on the host; 0 "
+         "restores the classic host-side metric.update path.")
+register("MXNET_MAX_STEPS_IN_FLIGHT", int, 2,
+         "Upper bound on dispatched-but-unfinished training steps in "
+         "fit(): the loop rides JAX's async dispatch and blocks on the "
+         "step-K-behind result rather than the current one, overlapping "
+         "host-side batch prep with device compute while bounding live "
+         "device buffers.  1 = fully synchronous loop (the dependency-"
+         "engine analog of the reference's NaiveEngine).")
+register("MXNET_PREFETCH_DEPTH", int, 2,
+         "How many batches DevicePrefetchIter keeps device-resident "
+         "ahead of the consumer (the dmlc::ThreadedIter capacity analog, "
+         "moved past the host->device DMA).")
+register("MXNET_DEVICE_PREFETCH", bool, True,
+         "Let fit() wrap the training iterator in a DevicePrefetchIter "
+         "when a fused train step is active, so the next batches are "
+         "device_put with the executor group's input sharding on a "
+         "background thread while the current step runs.  0 = feed "
+         "batches from the host thread as the reference does.")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

@@ -24,7 +24,8 @@ from . import ndarray as nd
 from .ndarray import NDArray, array
 
 __all__ = ["DataBatch", "DataIter", "DataDesc", "NDArrayIter", "MNISTIter",
-           "CSVIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
+           "CSVIter", "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
+           "ImageRecordIter"]
 
 
 class DataDesc:
@@ -386,22 +387,110 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
-class PrefetchingIter(DataIter):
+class _BackgroundIter(DataIter):
+    """Shared machinery for background-thread iterators (the
+    dmlc::ThreadedIter analog): a bounded queue, STOP-AWARE puts (a worker
+    blocked on a full queue observes close()/reset() instead of deadlocking
+    it), and exception propagation — a worker that dies re-raises in the
+    consumer on the next ``next()`` rather than hanging it forever.
+
+    Subclasses implement ``_produce()`` (return the next payload or raise
+    StopIteration) and ``_reset_source()``, then call ``_restart()`` once
+    constructed.
+    """
+
+    def __init__(self, batch_size, capacity):
+        super().__init__(batch_size)
+        self._capacity = max(1, int(capacity))
+        self._queue = None
+        self._stop = threading.Event()
+        self._thread = None
+        self._done = False
+
+    # -- worker side -------------------------------------------------------
+    def _produce(self):
+        raise NotImplementedError()
+
+    def _put(self, item):
+        """Queue.put that gives up when the consumer signalled stop."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                payload = self._produce()
+            except StopIteration:
+                self._put(("end", None))
+                return
+            except BaseException as exc:  # propagate, don't die silently
+                self._put(("error", exc))
+                return
+            if not self._put(("batch", payload)):
+                return
+
+    # -- consumer side -----------------------------------------------------
+    def _restart(self):
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=self._capacity)
+        self._done = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        """Stop the worker and join it; safe to call repeatedly.  A closed
+        iterator raises StopIteration from next() (no producer remains)."""
+        self._stop.set()
+        self._done = True
+        while self._thread is not None and self._thread.is_alive():
+            try:  # drain so a put()-blocked worker sees the stop flag
+                self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=0.01)
+        self._thread = None
+
+    def _reset_source(self):
+        raise NotImplementedError()
+
+    def reset(self):
+        self.close()
+        self._reset_source()
+        self._restart()
+
+    def __del__(self):
+        self._stop.set()
+
+    def next(self):
+        if self._done:
+            raise StopIteration
+        kind, payload = self._queue.get()
+        if kind == "batch":
+            return payload
+        self._done = True  # worker exited; don't block on an empty queue
+        if kind == "error":
+            raise payload
+        raise StopIteration
+
+
+class PrefetchingIter(_BackgroundIter):
     """Background-thread prefetch (reference: io.py:529 + iter_prefetcher.h)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
         if not isinstance(iters, list):
             iters = [iters]
-        super().__init__(iters[0].batch_size)
+        assert len(iters) > 0
+        super().__init__(iters[0].batch_size, capacity)
         self.n_iter = len(iters)
-        assert self.n_iter > 0
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
-        self._queue = _queue.Queue(maxsize=capacity)
-        self._stop = threading.Event()
-        self._thread = None
-        self._start()
+        self._restart()
 
     @property
     def provide_data(self):
@@ -421,46 +510,109 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
-    def _worker(self):
-        while not self._stop.is_set():
-            try:
-                batches = [i.next() for i in self.iters]
-            except StopIteration:
-                self._queue.put(None)
-                return
-            self._queue.put(batches)
-
-    def _start(self):
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
-
-    def __del__(self):
-        self._stop.set()
-
-    def reset(self):
-        # drain
-        self._stop.set()
-        while self._thread.is_alive():
-            try:
-                self._queue.get_nowait()
-            except _queue.Empty:
-                pass
-            self._thread.join(timeout=0.01)
-        for i in self.iters:
-            i.reset()
-        self._stop = threading.Event()
-        self._queue = _queue.Queue(maxsize=self._queue.maxsize)
-        self._start()
-
-    def next(self):
-        batches = self._queue.get()
-        if batches is None:
-            raise StopIteration
+    def _produce(self):
+        batches = [i.next() for i in self.iters]
         if self.n_iter == 1:
             return batches[0]
         return DataBatch(data=sum([b.data for b in batches], []),
                          label=sum([b.label for b in batches], []),
                          pad=batches[0].pad)
+
+    def _reset_source(self):
+        for i in self.iters:
+            i.reset()
+
+
+class DevicePrefetchIter(_BackgroundIter):
+    """Prefetch the next K batches ONTO THE DEVICE(S) while the current
+    step runs.
+
+    The background thread ``jax.device_put``s each upcoming batch with the
+    executor group's per-input sharding (data axis on 'data', time axis on
+    'seq' when sharded — the same rule the compiled step applies), so the
+    host→device DMA of step n+1 overlaps step n's compute instead of
+    serializing in front of it.  The consumer receives ``DataBatch``es whose
+    arrays are already device-resident; the train step's own ``device_put``
+    then sees an unchanged sharding and is a no-op.
+
+    ``module`` supplies the placement rule from its bound executor group
+    (looked up per batch, so reshape/rebind stay safe); alternatively pass
+    ``placement``: a callable ``(kind, name, ndarray) -> ndarray`` with kind
+    in {'data', 'label'}.  Depth defaults to ``MXNET_PREFETCH_DEPTH``.
+    """
+
+    def __init__(self, data_iter, module=None, depth=None, placement=None):
+        if depth is None:
+            from . import config as _config
+
+            depth = _config.get("MXNET_PREFETCH_DEPTH")
+        super().__init__(data_iter.batch_size, depth)
+        if placement is None:
+            if module is None:
+                raise MXNetError("DevicePrefetchIter needs a bound module "
+                                 "or an explicit placement function")
+            placement = _module_placement(module)
+            self._names = lambda kind: (module._exec_group.data_names
+                                        if kind == "data"
+                                        else module._exec_group.label_names)
+        else:
+            self._names = lambda kind: [d.name for d in
+                                        (self.data_iter.provide_data
+                                         if kind == "data"
+                                         else self.data_iter.provide_label
+                                         or [])]
+        self.data_iter = data_iter
+        self._placement = placement
+        self._restart()
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def _place_list(self, kind, arrs):
+        if not arrs:
+            return arrs
+        names = self._names(kind)
+        return [self._placement(kind, names[i], arr)
+                if i < len(names) else arr
+                for i, arr in enumerate(arrs)]
+
+    def _produce(self):
+        batch = self.data_iter.next()
+        return DataBatch(data=self._place_list("data", batch.data),
+                         label=self._place_list("label", batch.label),
+                         pad=batch.pad, index=batch.index,
+                         bucket_key=batch.bucket_key,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def _reset_source(self):
+        self.data_iter.reset()
+
+
+def _module_placement(module):
+    """Placement rule from a Module's executor group: cast to the bound
+    input dtype, then device_put with the group's input sharding."""
+
+    def place(kind, name, arr):
+        import jax
+
+        group = module._exec_group
+        dst = group.exec_.arg_dict.get(name)
+        v = arr.data if isinstance(arr, NDArray) else np.asarray(arr)
+        if dst is not None and v.dtype != dst.data.dtype:
+            v = v.astype(dst.data.dtype)
+        if group._mesh is not None:
+            target = group._input_sharding(name)
+        else:
+            target = group.contexts[0].jax_device
+        return NDArray(jax.device_put(v, target), group.contexts[0])
+
+    return place
 
 
 def ImageRecordIter(**kwargs):

@@ -7,6 +7,15 @@ around a different core: every metric reduces one (label, pred) pair to a
 expression (``_batch``), and the base class owns pairing, accumulation and
 reporting.  No per-row Python loops — metric cost stays negligible next to
 the compiled step even for large batches.
+
+Device-side accumulation: metrics that additionally implement
+``device_batch`` (the jax.numpy mirror of ``_batch``) can accumulate INSIDE
+the donated train-step program — the per-step device→host output transfer
+of the classic loop disappears, and the host only syncs the two-scalar
+accumulator at ``MXNET_METRIC_SYNC_PERIOD`` boundaries.  The reference
+routed metric reads through the same dependency engine as ops; here the
+accumulator is literally part of the step's donated state.  See
+``DeviceMetricAccumulator`` for the protocol the module drivers use.
 """
 from __future__ import annotations
 
@@ -14,11 +23,31 @@ import numpy as np
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity",
            "MAE", "MSE", "RMSE", "CrossEntropy", "Loss", "Torch", "Caffe",
-           "CustomMetric", "CompositeEvalMetric", "np_metric", "create"]
+           "CustomMetric", "CompositeEvalMetric", "np_metric", "create",
+           "DeviceMetricAccumulator", "select_outputs"]
+
+
+def select_outputs(metric, outputs):
+    """The output heads ``metric`` consumes: ``metric.output_indices`` when
+    set, else all of them.  The module drivers route every metric through
+    this so unnamed heads are never materialized on the host."""
+    idxs = getattr(metric, "output_indices", None)
+    if idxs is None:
+        return outputs
+    return [outputs[i] for i in idxs]
 
 
 def _host(x):
-    """Materialize an NDArray / jax array / numpy array on the host."""
+    """Materialize an NDArray / jax array / numpy array on the host.
+
+    Every call on a non-numpy input is a device→host transfer; the profiler
+    counts them so the bench can report ``host_syncs_per_step`` (the number
+    device-side accumulation exists to drive to ~0)."""
+    if isinstance(x, np.ndarray):
+        return x
+    from . import profiler as _prof
+
+    _prof.bump_metric_d2h()
     if hasattr(x, "asnumpy"):
         return x.asnumpy()
     return np.asarray(x)
@@ -35,21 +64,72 @@ def check_label_shapes(labels, preds, shape=0):
 
 class EvalMetric:
     """Accumulating metric base.  Subclasses implement ``_batch(label,
-    pred) -> (sum, count)`` over host arrays; everything else lives here."""
+    pred) -> (sum, count)`` over host arrays; everything else lives here.
+
+    Subclasses may ALSO implement ``device_batch(label, pred)`` — the same
+    reduction written in jax.numpy over device arrays — to opt into
+    device-side accumulation inside compiled train steps.  A bound device
+    accumulator is drained lazily: ``get()``/``get_name_value()`` (and the
+    ``sum_metric``/``num_inst`` views) first fold any pending device state
+    into the host sums, so callbacks keep working unchanged — reading the
+    metric IS the sync point.
+    """
+
+    # jax.numpy mirror of _batch; None = host-only metric
+    device_batch = None
+
+    # Which output heads this metric consumes (e.g. ``metric.output_indices
+    # = [0]`` on a multi-head Group symbol).  None = all heads.  The module
+    # drivers slice the output list BEFORE handing it over, so unused heads
+    # are never materialized on the host.
+    output_indices = None
 
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
+        self._device_sync = None    # drain pending device state -> host
+        self._device_reset = None   # zero device state without draining
         self.reset()
 
     def reset(self):
+        hook = getattr(self, "_device_reset", None)
+        if hook is not None:
+            hook()
         n = 1 if self.num is None else self.num
         self._sums = [0.0] * n
         self._counts = [0] * n
 
+    def _drain_device(self):
+        hook = getattr(self, "_device_sync", None)
+        if hook is not None:
+            hook()
+
+    # ------------------------------------------------------------------
+    # device-accumulation protocol (DeviceMetricAccumulator drives this)
+    # ------------------------------------------------------------------
+    def device_supported(self):
+        """Whether this metric can accumulate inside a compiled step."""
+        return self.device_batch is not None
+
+    def device_update(self, sums, counts, labels, preds):
+        """Traceable mirror of ``update``: fold one batch of device arrays
+        into per-slot accumulator lists IN PLACE.  Default pairing matches
+        ``update`` (zip labels with preds); metrics with different pairing
+        semantics (Loss) override this instead of ``device_batch``."""
+        if self.device_batch is None:
+            raise NotImplementedError("%s has no device_batch"
+                                      % type(self).__name__)
+        check_label_shapes(labels, preds)
+        for slot, (label, pred) in enumerate(zip(labels, preds)):
+            s, n = self.device_batch(label, pred)
+            idx = 0 if self.num is None else slot
+            sums[idx] = sums[idx] + s
+            counts[idx] = counts[idx] + n
+
     # reference-compatible attribute views (Module/callbacks poke these)
     @property
     def sum_metric(self):
+        self._drain_device()
         return self._sums[0] if self.num is None else self._sums
 
     @sum_metric.setter
@@ -61,6 +141,7 @@ class EvalMetric:
 
     @property
     def num_inst(self):
+        self._drain_device()
         return self._counts[0] if self.num is None else self._counts
 
     @num_inst.setter
@@ -82,6 +163,8 @@ class EvalMetric:
             self._counts[idx] += n
 
     def get(self):
+        self._drain_device()
+
         def ratio(s, n):
             return s / n if n != 0 else float("nan")
 
@@ -114,6 +197,15 @@ class Accuracy(EvalMetric):
         eq = hard.astype("int64").ravel() == label.astype("int64").ravel()
         return int(eq.sum()), eq.size
 
+    def device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        hard = pred if pred.shape == label.shape \
+            else jnp.argmax(pred, axis=self.axis)
+        check_label_shapes(label, hard, shape=1)
+        eq = hard.astype(jnp.int32).ravel() == label.astype(jnp.int32).ravel()
+        return eq.sum(), eq.size
+
 
 class TopKAccuracy(EvalMetric):
     """Label-in-top-k accuracy.  Uses an O(n) partial partition of the
@@ -133,6 +225,19 @@ class TopKAccuracy(EvalMetric):
         topk = np.argpartition(pred, -k, axis=1)[:, -k:]
         hits = (topk == label.astype("int64")[:, None]).any(axis=1)
         return int(hits.sum()), hits.size
+
+    def device_batch(self, label, pred):
+        import jax
+        import jax.numpy as jnp
+
+        assert pred.ndim <= 2, "predictions must be at most (batch, classes)"
+        if pred.ndim == 1:
+            eq = pred.astype(jnp.int32) == label.astype(jnp.int32).ravel()
+            return eq.sum(), eq.size
+        k = min(self.top_k, pred.shape[1])
+        _, topk = jax.lax.top_k(pred, k)
+        hits = (topk == label.astype(jnp.int32)[:, None]).any(axis=1)
+        return hits.sum(), hits.size
 
 
 class F1(EvalMetric):
@@ -176,6 +281,20 @@ class Perplexity(EvalMetric):
         count = int(keep.sum())
         return float(np.exp(nll / count)) if count else float("nan"), 1
 
+    def device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        flat = pred.reshape(-1, pred.shape[self.axis])
+        ids = label.astype(jnp.int32).ravel()
+        p = jnp.take_along_axis(flat, ids[:, None], axis=1)[:, 0]
+        keep = jnp.ones_like(p, dtype=bool) if self.ignore_label is None \
+            else ids != self.ignore_label
+        nll = -(jnp.log(jnp.maximum(p, 1e-10)) * keep).sum()
+        count = keep.sum()
+        stat = jnp.where(count > 0, jnp.exp(nll / jnp.maximum(count, 1)),
+                         jnp.nan)
+        return stat, 1
+
 
 class _Regression(EvalMetric):
     """Shared shape handling for elementwise regression errors."""
@@ -184,6 +303,13 @@ class _Regression(EvalMetric):
         if label.ndim == 1:
             label = label[:, None]
         return float(self._error(label, pred)), 1
+
+    def device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        if label.ndim == 1:
+            label = label[:, None]
+        return self._error_ops(jnp)(label, pred), 1
 
 
 class MAE(_Regression):
@@ -194,6 +320,10 @@ class MAE(_Regression):
     def _error(label, pred):
         return np.mean(np.abs(label - pred))
 
+    @staticmethod
+    def _error_ops(xp):
+        return lambda label, pred: xp.mean(xp.abs(label - pred))
+
 
 class MSE(_Regression):
     def __init__(self):
@@ -203,6 +333,10 @@ class MSE(_Regression):
     def _error(label, pred):
         return np.mean(np.square(label - pred))
 
+    @staticmethod
+    def _error_ops(xp):
+        return lambda label, pred: xp.mean(xp.square(label - pred))
+
 
 class RMSE(_Regression):
     def __init__(self):
@@ -211,6 +345,10 @@ class RMSE(_Regression):
     @staticmethod
     def _error(label, pred):
         return np.sqrt(np.mean(np.square(label - pred)))
+
+    @staticmethod
+    def _error_ops(xp):
+        return lambda label, pred: xp.sqrt(xp.mean(xp.square(label - pred)))
 
 
 class CrossEntropy(EvalMetric):
@@ -226,6 +364,14 @@ class CrossEntropy(EvalMetric):
         p = np.take_along_axis(pred, ids[:, None], axis=1)[:, 0]
         return float(-np.log(p + self.eps).sum()), ids.size
 
+    def device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        ids = label.astype(jnp.int32).ravel()
+        assert ids.size == pred.shape[0]
+        p = jnp.take_along_axis(pred, ids[:, None], axis=1)[:, 0]
+        return -jnp.log(p + self.eps).sum(), ids.size
+
 
 class Loss(EvalMetric):
     """Mean of raw outputs (MakeLoss-style nets); ignores labels."""
@@ -238,6 +384,15 @@ class Loss(EvalMetric):
             arr = _host(pred)
             self._sums[0] += float(arr.sum())
             self._counts[0] += arr.size
+
+    def device_supported(self):
+        return True
+
+    def device_update(self, sums, counts, labels, preds):
+        # same pairing as update(): every output head, labels ignored
+        for pred in preds:
+            sums[0] = sums[0] + pred.sum()
+            counts[0] = counts[0] + pred.size
 
 
 class Torch(Loss):
@@ -294,7 +449,17 @@ class CompositeEvalMetric(EvalMetric):
 
     def update(self, labels, preds):
         for m in self.metrics:
-            m.update(labels, preds)
+            # per-child head selection, mirroring the device accumulator's
+            # per-leaf select_outputs so host and device paths agree
+            m.update(labels, select_outputs(m, preds))
+
+    def device_supported(self):
+        # composite-level output_indices is applied by the drivers BEFORE
+        # the update call on the host path; the flattened device
+        # accumulator can't reproduce that nesting, so such composites
+        # stay on the host path
+        return bool(self.metrics) and self.output_indices is None and \
+            all(m.device_supported() for m in self.metrics)
 
     def reset(self):
         for m in getattr(self, "metrics", []):
@@ -303,6 +468,134 @@ class CompositeEvalMetric(EvalMetric):
     def get(self):
         pairs = [m.get() for m in self.metrics]
         return [p[0] for p in pairs], [p[1] for p in pairs]
+
+
+class DeviceMetricAccumulator:
+    """Bridge between an ``EvalMetric`` and donated on-device accumulator
+    state inside a compiled train step.
+
+    The owner (``CompiledTrainStep`` / ``PipelineModule``) threads
+    ``self.state`` — a pytree of per-slot ``(sum, count)`` scalars — through
+    its jitted program as extra DONATED state, calling :meth:`update` inside
+    the trace.  :meth:`install` binds drain/reset hooks onto the metric so
+    reading it (``get``/``get_name_value``/``sum_metric``) lazily folds the
+    device accumulators into the host sums — reading the metric is the sync
+    point, exactly the reference's engine-mediated ``WaitToRead`` on a
+    metric variable.
+    """
+
+    def __init__(self, metric):
+        self.metric = metric
+        self._leaves = self._flatten(metric)
+        bad = [type(m).__name__ for m in self._leaves
+               if not m.device_supported()]
+        if bad or not self._leaves:
+            raise ValueError("metric(s) %s cannot accumulate on device"
+                             % (bad or metric))
+        self.state = None
+        self.dirty = False  # anything accumulated since the last drain?
+
+    @staticmethod
+    def _flatten(metric):
+        if isinstance(metric, CompositeEvalMetric):
+            out = []
+            for m in metric.metrics:
+                out.extend(DeviceMetricAccumulator._flatten(m))
+            return out
+        return [metric]
+
+    @staticmethod
+    def supported(metric):
+        """Whether every leaf of ``metric`` implements the device protocol."""
+        try:
+            return bool(metric.device_supported())
+        except Exception:
+            return False
+
+    def _zeros(self):
+        import jax.numpy as jnp
+
+        # strong dtypes (x64-aware) so the scalars stay donatable
+        fdt = jnp.asarray(0.0).dtype
+        idt = jnp.asarray(0).dtype
+        state = []
+        for m in self._leaves:
+            n = 1 if m.num is None else m.num
+            state.append((tuple(jnp.zeros((), fdt) for _ in range(n)),
+                          tuple(jnp.zeros((), idt) for _ in range(n))))
+        return tuple(state)
+
+    # ------------------------------------------------------------------
+    def update(self, state, labels, preds):
+        """Traceable: fold one batch (device arrays) into the state pytree."""
+        new = []
+        for (sums, counts), m in zip(state, self._leaves):
+            s, c = list(sums), list(counts)
+            m.device_update(s, c, labels, select_outputs(m, preds))
+            new.append((tuple(s), tuple(c)))
+        return tuple(new)
+
+    # ------------------------------------------------------------------
+    def install(self):
+        """Arm device accumulation: zero state + bind the metric hooks."""
+        if self.state is None:
+            self.state = self._zeros()
+        for m in self._leaves:
+            m._device_sync = self.drain
+            m._device_reset = self.reset_device
+
+    def uninstall(self):
+        """Drain what's pending and detach the hooks (fused→eager handoff,
+        monitor installation, end of fit)."""
+        self.drain()
+        for m in self._leaves:
+            m._device_sync = None
+            m._device_reset = None
+        self.state = None
+
+    def commit(self, state):
+        """Store the step program's returned accumulator state."""
+        self.state = state
+        self.dirty = True
+
+    def maybe_drain(self, num_steps):
+        """Periodic-drain policy: sync every ``MXNET_METRIC_SYNC_PERIOD``
+        steps (0 = only at boundaries).  The module drivers call this from
+        ``update_metric`` once per step."""
+        from . import config as _config
+
+        period = _config.get("MXNET_METRIC_SYNC_PERIOD")
+        if period and num_steps % int(period) == 0:
+            self.drain()
+
+    def drain(self):
+        """Fold pending device accumulators into the host metric sums and
+        zero the device state — the loop's only metric device→host sync.
+        A clean accumulator (nothing since the last drain) costs nothing."""
+        if self.state is None or not self.dirty:
+            return
+        import jax
+
+        from . import profiler as _prof
+
+        state, self.state = self.state, None  # re-entrancy guard
+        self.dirty = False
+        host = jax.device_get(state)  # ONE batched transfer, not per-scalar
+        moved = 0
+        for (sums, counts), m in zip(host, self._leaves):
+            for idx, (s, c) in enumerate(zip(sums, counts)):
+                m._sums[idx] += float(s)
+                m._counts[idx] += int(c)
+                moved += 2
+        _prof.bump_metric_d2h(moved)
+        _prof.bump_metric_sync()
+        self.state = self._zeros()
+
+    def reset_device(self):
+        """Zero the device accumulators WITHOUT folding (metric.reset)."""
+        if self.state is not None:
+            self.state = self._zeros()
+        self.dirty = False
 
 
 def np_metric(name=None, allow_extra_outputs=False):

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import logging
 import time
-from collections import namedtuple
+from collections import deque, namedtuple
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
@@ -30,6 +30,13 @@ def _callbacks(cb):
 def _fire(cbs, *args):
     for cb in _callbacks(cbs):
         cb(*args)
+
+
+def _block_on(fence):
+    """Block until a dispatched step's result is materialized on device."""
+    import jax
+
+    jax.block_until_ready(fence)
 
 
 class BaseModule:
@@ -132,21 +139,82 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+    # ------------------------------------------------------------------
+    # async-loop hooks (overridden by drivers with compiled steps)
+    # ------------------------------------------------------------------
+    def _bind_metric(self, eval_metric):
+        """Give the driver a chance to fold ``eval_metric``'s accumulation
+        into its compiled step (device-side metrics).  Default: host path."""
+
+    def _wrap_train_data(self, train_data):
+        """Optionally wrap the training iterator (device prefetch).  The
+        wrapper must preserve reset(); fit() closes it when it adds one."""
+        return train_data
+
+    def _dispatch_fence(self):
+        """A device array that completes when the most recently dispatched
+        training step has finished, or None when the driver executes
+        synchronously.  fit() bounds the number of outstanding steps by
+        blocking on the step-K-behind fence."""
+        return None
+
     def _fit_epoch(self, epoch, train_data, eval_metric, batch_end_callback,
                    monitor):
-        """One pass over train_data; returns the wall-clock cost."""
+        """One pass over train_data; returns the wall-clock cost.
+
+        The loop rides JAX's async dispatch: with a compiled step and
+        device-side metric accumulation the body performs no host sync, so
+        up to ``MXNET_MAX_STEPS_IN_FLIGHT`` steps stay outstanding and the
+        host prepares batch n+K while the device runs step n.  Device
+        memory is bounded by blocking on the step-K-behind fence rather
+        than the current result (the dependency-engine analog: the host
+        throttles on an OLD variable's WaitToRead, never the newest).
+        Input-pipeline stalls and host waits are recorded in
+        ``profiler.step_stats`` for the bench contract.
+        """
+        from .. import config as _config
+        from .. import profiler as _prof
+
         start = time.time()
         eval_metric.reset()
-        for nbatch, batch in enumerate(train_data):
+        limit = max(1, int(_config.get("MXNET_MAX_STEPS_IN_FLIGHT")))
+        fences = deque()
+        nbatch = 0
+        it = iter(train_data)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            _prof.record_input_wait(time.perf_counter() - t0)
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(batch)
             self.update()
             self.update_metric(eval_metric, batch.label)
+            fence = self._dispatch_fence()
+            if fence is not None:
+                fences.append(fence)
+                # at most `limit` dispatched-but-unfinished steps: with
+                # limit=1 this waits on the step just issued (synchronous)
+                if len(fences) >= limit:
+                    t0 = time.perf_counter()
+                    _block_on(fences.popleft())
+                    _prof.record_host_wait(time.perf_counter() - t0)
             if monitor is not None:
                 monitor.toc_print()
+            _prof.record_step()
             _fire(batch_end_callback,
                   BatchEndParam(epoch, nbatch, eval_metric, locals()))
+            nbatch += 1
+        if fences:
+            # steps chain through donated params, so the newest fence
+            # transitively covers every outstanding step
+            t0 = time.perf_counter()
+            _block_on(fences[-1])
+            _prof.record_host_wait(time.perf_counter() - t0)
+            fences.clear()
         return time.time() - start
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
@@ -168,28 +236,47 @@ class BaseModule:
                          optimizer_params=optimizer_params, monitor=monitor)
         eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+        # async loop setup: device-side metric accumulation in the compiled
+        # step, and device prefetch of upcoming batches (both no-ops for
+        # drivers/configs without a fused step)
+        self._bind_metric(eval_metric)
+        fit_data = self._wrap_train_data(train_data)
 
-        for epoch in range(begin_epoch, num_epoch):
-            cost = self._fit_epoch(epoch, train_data, eval_metric,
-                                   batch_end_callback, monitor)
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, cost)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                if epoch > begin_epoch:
+                    # reset at epoch START: after the last epoch there is
+                    # no reset, so a prefetching wrapper's worker is not
+                    # restarted just to have its read-ahead thrown away
+                    fit_data.reset()
+                cost = self._fit_epoch(epoch, fit_data, eval_metric,
+                                       batch_end_callback, monitor)
+                # reading the metric drains any pending device accumulation
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, cost)
 
-            # materialize params host-side once per epoch: checkpoints and
-            # user callbacks observe a consistent snapshot
-            arg_snap, aux_snap = self.get_params()
-            self.set_params(arg_snap, aux_snap)
-            _fire(epoch_end_callback, epoch, self.symbol, arg_snap, aux_snap)
+                # materialize params host-side once per epoch: checkpoints
+                # and user callbacks observe a consistent snapshot
+                arg_snap, aux_snap = self.get_params()
+                self.set_params(arg_snap, aux_snap)
+                _fire(epoch_end_callback, epoch, self.symbol, arg_snap,
+                      aux_snap)
 
-            if eval_data:
-                for name, val in self.score(
-                        eval_data, validation_metric,
-                        score_end_callback=eval_end_callback,
-                        batch_end_callback=eval_batch_end_callback,
-                        epoch=epoch):
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
+                if eval_data:
+                    for name, val in self.score(
+                            eval_data, validation_metric,
+                            score_end_callback=eval_end_callback,
+                            batch_end_callback=eval_batch_end_callback,
+                            epoch=epoch):
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+        finally:
+            if fit_data is not train_data and hasattr(fit_data, "close"):
+                fit_data.close()
+            # fit() leaves the caller's iterator fresh (the pre-async loop
+            # reset after every epoch; a second fit() must not silently
+            # iterate zero batches)
             train_data.reset()
 
     # ------------------------------------------------------------------

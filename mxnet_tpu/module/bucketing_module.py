@@ -37,6 +37,7 @@ class BucketingModule(BaseModule):
         self._active = None
         self._active_key = None
         self._params_dirty = False
+        self._fit_metric = None
 
     @property
     def _primary(self):
@@ -150,6 +151,8 @@ class BucketingModule(BaseModule):
             if self.optimizer_initialized:
                 module.borrow_optimizer(self._primary)
                 self._ensure_fused_compat(module)
+            if self._fit_metric is not None:
+                module._bind_metric(self._fit_metric)
             self._by_key[bucket_key] = module
         self._active = module
         self._active_key = bucket_key
@@ -229,6 +232,19 @@ class BucketingModule(BaseModule):
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
         self._active.update_metric(eval_metric, labels)
+
+    def _bind_metric(self, eval_metric):
+        # every bucket shares ONE fused store, so attaching through any
+        # bucket module arms accumulation for all of them; remember the
+        # metric for buckets bound later in the epoch
+        self._fit_metric = eval_metric
+        for module in self._by_key.values():
+            module._bind_metric(eval_metric)
+
+    def _dispatch_fence(self):
+        if self._active is None:
+            return None
+        return self._active._dispatch_fence()
 
     def install_monitor(self, mon):
         assert self.binded

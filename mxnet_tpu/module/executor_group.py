@@ -357,7 +357,14 @@ class DataParallelExecutorGroup:
         return [self.exec_.grad_dict[n] for n in self.data_names]
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self.get_outputs())
+        from .. import metric as metric_mod
+
+        # pull only the output heads the metric actually consumes
+        # (metric.output_indices); every head it doesn't name stays an
+        # unmaterialized device array instead of riding a d2h transfer
+        eval_metric.update(
+            labels, list(metric_mod.select_outputs(eval_metric,
+                                                   self.exec_.outputs)))
 
     def install_monitor(self, mon):
         for exe in self.execs:

@@ -37,6 +37,7 @@ class Module(BaseModule):
         self._fused_step = None
         self._fused_outputs = None
         self._fused_update_done = False   # update() becomes a no-op for it
+        self._pending_metric = None       # metric to fold into the step
         self._step_stale = False          # executor arrays newer than step
         self._exec_stale = False          # step newer than executor arrays
         self._opt_owner = "eager"         # who holds live optimizer slots
@@ -253,7 +254,10 @@ class Module(BaseModule):
         # the host param dicts before the executor they came from is dropped
         if self._fused_step is not None and self.params_initialized:
             self._sync_params_from_devices()
+        if self._fused_step is not None:
+            self._fused_step.detach_metric()
         self._fused_step = None
+        self._pending_metric = None
         self._fused_outputs = None
         self._fused_update_done = False
         self._step_stale = False
@@ -394,6 +398,8 @@ class Module(BaseModule):
         """Compile forward+backward+optimizer into one donated XLA program
         when the configuration allows it."""
         self._flush_fused()  # re-init must not revert trained weights
+        if self._fused_step is not None:
+            self._fused_step.detach_metric()
         self._fused_step = None
         if not self._fused_eligible(self._optimizer, self._kvstore):
             return
@@ -440,6 +446,11 @@ class Module(BaseModule):
     def _run_fused(self, data_batch):
         from .. import ndarray as _nd
 
+        if self._pending_metric is not None:
+            # arm device-side metric accumulation once; a metric the step
+            # can't host stays on the classic update_metric path
+            self._fused_step.attach_metric(self._pending_metric)
+            self._pending_metric = None
         if self._step_stale:
             self._fused_step.load_from_executor()
             self._step_stale = False
@@ -524,9 +535,51 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         if self._fused_outputs is not None:
-            eval_metric.update(labels, self._fused_outputs)
+            step = self._fused_step
+            acc = step._metric_acc if step is not None else None
+            if acc is not None and acc.metric is eval_metric:
+                # already accumulated INSIDE the step program — no host
+                # read; the accumulator applies the periodic-drain policy
+                acc.maybe_drain(step.num_steps)
+                return
+            from .. import metric as metric_mod
+
+            eval_metric.update(labels, metric_mod.select_outputs(
+                eval_metric, self._fused_outputs))
         else:
             self._exec_group.update_metric(eval_metric, labels)
+
+    def _bind_metric(self, eval_metric):
+        from .. import config as _config
+
+        self._pending_metric = None
+        if self._fused_step is None:
+            return
+        if not _config.get("MXNET_DEVICE_METRICS"):
+            # knob turned off between fits: a previously armed accumulator
+            # must actually come off the program, not linger
+            self._fused_step.detach_metric()
+            return
+        acc = self._fused_step._metric_acc
+        if acc is not None and acc.metric is not eval_metric:
+            # don't keep accumulating into the previous fit's metric
+            self._fused_step.detach_metric()
+        self._pending_metric = eval_metric
+
+    def _wrap_train_data(self, train_data):
+        from .. import config as _config
+        from ..io import DevicePrefetchIter
+
+        if self._fused_step is None \
+                or not _config.get("MXNET_DEVICE_PREFETCH") \
+                or isinstance(train_data, DevicePrefetchIter):
+            return train_data
+        return DevicePrefetchIter(train_data, module=self)
+
+    def _dispatch_fence(self):
+        if self._fused_outputs is None or not self._fused_outputs:
+            return None
+        return self._fused_outputs[0].data
 
     def _sync_params_from_devices(self):
         self._flush_fused()
@@ -539,6 +592,8 @@ class Module(BaseModule):
         if self._fused_step is None or self._opt_owner != "fused":
             return
         self._flush_fused()
+        self._fused_step.detach_metric()  # drains pending device sums
+        self._pending_metric = None
         if self._updater is not None:
             self._fused_step.export_updater_states(
                 self._updater, self._exec_group.param_names,

@@ -276,6 +276,16 @@ class PipelineModule(BaseModule):
         self.params_initialized = False
         self.optimizer_initialized = False
         self._outputs = None
+        # async-loop state: device-side metric accumulation folded into the
+        # pipelined step program (metric.DeviceMetricAccumulator) + a step
+        # counter for MXNET_METRIC_SYNC_PERIOD
+        self._pending_metric = None
+        self._metric_acc = None
+        self._metric_traced = False
+        self._num_steps = 0
+        # whether _outputs came from a train step (device-accumulated) or a
+        # forward-only program (score/predict: metrics update on the host)
+        self._outputs_from_step = False
 
     # ------------------------------------------------------------------
     @property
@@ -450,6 +460,7 @@ class PipelineModule(BaseModule):
         self._step = None
         self._fwd_fns = {}
         self._hyper_cache = None
+        self._detach_metric()
         self.binded = True
 
     # ------------------------------------------------------------------
@@ -580,6 +591,7 @@ class PipelineModule(BaseModule):
         label_names = self._label_names
         opt_apply = self._opt_apply
         order = self._param_order
+        macc = self._metric_acc
 
         stage_specs = {n: P(*(("pipe",) + (None,) * len(s)))
                        for n, s in self._stage_shapes.items()}
@@ -626,8 +638,8 @@ class PipelineModule(BaseModule):
             env.update(labels)
             return head_fn(env, True, rng)
 
-        def step(params, slots, x, labels, lrs, wds, rescale, clip, extra,
-                 rng):
+        def step(params, slots, mstate, x, labels, lrs, wds, rescale, clip,
+                 extra, rng):
             outs, vjp_fn = jax.vjp(
                 lambda p: fwd(p, x, labels, rng), params)
             cts = [jnp.ones_like(o) for o in outs]
@@ -641,9 +653,14 @@ class PipelineModule(BaseModule):
                 new_params[nme] = w.astype(params[nme].dtype)
                 new_slots[nme] = tuple(
                     sn.astype(so.dtype) for sn, so in zip(s, slots[nme]))
-            return new_params, new_slots, outs
+            if macc is not None:
+                # metric accumulation inside the pipelined program: reads
+                # the head outputs/labels, feeds nothing back into training
+                mstate = macc.update(mstate, [labels[n] for n in label_names],
+                                     list(outs))
+            return new_params, new_slots, mstate, outs
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _build_fwd_only(self, is_train):
         """Forward-only program (no grads, no update) for forward()/score."""
@@ -698,12 +715,59 @@ class PipelineModule(BaseModule):
         return jax.jit(eval_fn)
 
     # ------------------------------------------------------------------
+    # device-side metrics (same protocol as CompiledTrainStep)
+    # ------------------------------------------------------------------
+    def _bind_metric(self, eval_metric):
+        from .. import config as _config
+
+        self._pending_metric = None
+        if not _config.get("MXNET_DEVICE_METRICS"):
+            if self._metric_acc is not None:
+                self._detach_metric()  # knob off: actually disarm
+            return
+        if self._metric_acc is not None \
+                and self._metric_acc.metric is not eval_metric:
+            self._detach_metric()
+        self._pending_metric = eval_metric
+
+    def _try_attach_metric(self, data_batch):
+        from ..metric import DeviceMetricAccumulator
+
+        metric = self._pending_metric
+        self._pending_metric = None
+        if not DeviceMetricAccumulator.supported(metric):
+            return
+        # device pairing must mirror the host update_metric(labels, outs)
+        # call exactly: every iterator label must be a head input
+        if len(data_batch.label or []) != len(self._label_names) or \
+                [d.name for d in self._label_shapes] != self._label_names:
+            return
+        self._metric_acc = DeviceMetricAccumulator(metric)
+        self._metric_acc.install()
+        self._metric_traced = False
+        self._step = None  # program signature changed: recompile
+
+    def _detach_metric(self):
+        if self._metric_acc is not None:
+            self._metric_acc.uninstall()
+            self._metric_acc = None
+        self._metric_traced = False
+        self._step = None
+
+    def _dispatch_fence(self):
+        if self._outputs:
+            return self._outputs[0]
+        return None
+
+    # ------------------------------------------------------------------
     def forward_backward(self, data_batch):
         """One fused train step (forward + reverse pipeline + update)."""
         import jax
 
         from .. import random as _rnd
 
+        if self._pending_metric is not None and self._metric_acc is None:
+            self._try_attach_metric(data_batch)
         if self._step is None:
             self._step = self._build_step()
         x = jax.device_put(data_batch.data[0].data, self._x_sharding)
@@ -730,10 +794,33 @@ class PipelineModule(BaseModule):
                    jnp.asarray(extra))
             self._hyper_cache = (lrs, wds, rescale, clip, extra, dev)
             lrs, wds, rescale, clip, extra = dev
-        self._params, self._slots, outs = self._step(
-            self._params, self._slots, x, labels, lrs, wds, rescale, clip,
-            extra, _rnd.split_key())
+        acc = self._metric_acc
+        mstate = acc.state if acc is not None else ()
+        rng = _rnd.split_key()
+        if acc is not None and not self._metric_traced:
+            # trace-only validation (same policy as CompiledTrainStep.run):
+            # eval_shape executes nothing, so a metric mirror that can't
+            # trace against the head graph demotes to the host path
+            # without risking the step's donated buffers
+            try:
+                jax.eval_shape(self._step, self._params, self._slots,
+                               mstate, x, labels, lrs, wds, rescale, clip,
+                               extra, rng)
+                self._metric_traced = True
+            except Exception as exc:
+                self.logger.info("device metric accumulation unavailable "
+                                 "(%s); metric stays on the host path", exc)
+                self._detach_metric()
+                self._step = self._build_step()
+                acc, mstate = None, ()
+        self._params, self._slots, mstate, outs = self._step(
+            self._params, self._slots, mstate, x, labels, lrs, wds,
+            rescale, clip, extra, rng)
+        if acc is not None:
+            acc.commit(mstate)
+        self._num_steps += 1
         self._outputs = outs
+        self._outputs_from_step = True
 
     def update(self):
         pass  # the optimizer update is fused into the step program
@@ -757,6 +844,7 @@ class PipelineModule(BaseModule):
         x = jax.device_put(data_batch.data[0].data, self._x_sharding)
         self._outputs = self._fwd_fns[bool(is_train)](
             self._params, x, _rnd.split_key())
+        self._outputs_from_step = False
 
     def get_outputs(self, merge_multi_context=True):
         return [nd.NDArray(o, self._context[0]) for o in self._outputs]
@@ -766,7 +854,20 @@ class PipelineModule(BaseModule):
                          "PipelineModule")
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self.get_outputs())
+        acc = self._metric_acc
+        # the device path only covers outputs the STEP program produced;
+        # score()/predict() run the forward-only program (no accumulation
+        # in it) and must keep updating on the host even when the same
+        # metric object is armed for training (fit's validation_metric
+        # defaults to the train metric)
+        if acc is not None and acc.metric is eval_metric \
+                and self._outputs_from_step:
+            acc.maybe_drain(self._num_steps)
+            return
+        from .. import metric as metric_mod
+
+        eval_metric.update(labels, metric_mod.select_outputs(
+            eval_metric, self.get_outputs()))
 
     def install_monitor(self, mon):
         raise MXNetError("per-op monitoring is not available inside the "

@@ -16,11 +16,90 @@ import time
 import threading
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "Scope", "start", "stop"]
+           "Scope", "start", "stop", "record_host_wait", "record_input_wait",
+           "record_step", "bump_metric_d2h", "bump_metric_sync",
+           "step_stats", "reset_step_stats"]
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "events": [], "jax_trace_dir": None}
 _lock = threading.Lock()
+
+# ---------------------------------------------------------------------------
+# Training-loop step accounting (always on — counters only; span events are
+# recorded only while the profiler runs).  The async fit loop reports where
+# the host thread's time went: blocked on device results (host_wait), blocked
+# on the input pipeline (input_wait), or free to run ahead.  metric_d2h
+# counts device->host array materializations on behalf of metrics — the
+# transfers MXNET_METRIC_SYNC_PERIOD exists to eliminate.
+# ---------------------------------------------------------------------------
+_STEP_KEYS = ("steps", "host_wait_s", "input_wait_s", "metric_d2h",
+              "metric_syncs")
+_step = dict.fromkeys(_STEP_KEYS, 0)
+_step["host_wait_s"] = _step["input_wait_s"] = 0.0
+_step["t0"] = time.time()
+
+
+def _span(name, t0, dur):
+    if _state["running"]:
+        _state["events"].append({
+            "name": name, "cat": "loop", "ph": "X", "ts": int(t0 * 1e6),
+            "dur": int(dur * 1e6), "pid": os.getpid(),
+            "tid": threading.get_ident()})
+
+
+def record_host_wait(seconds):
+    """Time the loop spent blocked on a device result (fence/metric sync)."""
+    with _lock:
+        _step["host_wait_s"] += seconds
+        _span("host_wait", time.time() - seconds, seconds)
+
+
+def record_input_wait(seconds):
+    """Time the loop spent waiting for the input pipeline's next batch."""
+    with _lock:
+        _step["input_wait_s"] += seconds
+        _span("input_wait", time.time() - seconds, seconds)
+
+
+def record_step(n=1):
+    """One (or n) training steps dispatched."""
+    with _lock:
+        _step["steps"] += n
+
+
+def bump_metric_d2h(n=1):
+    """n device->host transfers performed on behalf of a metric."""
+    with _lock:
+        _step["metric_d2h"] += n
+
+
+def bump_metric_sync(n=1):
+    """n device-accumulator drains (each moves the whole accumulator)."""
+    with _lock:
+        _step["metric_syncs"] += n
+
+
+def reset_step_stats():
+    with _lock:
+        for k in _STEP_KEYS:
+            _step[k] = 0
+        _step["host_wait_s"] = _step["input_wait_s"] = 0.0
+        _step["t0"] = time.time()
+
+
+def step_stats():
+    """Snapshot of loop accounting plus the derived bench-contract ratios:
+    ``input_stall_fraction`` (share of wall time blocked on input) and
+    ``host_syncs_per_step`` (metric-driven d2h transfers per step)."""
+    with _lock:
+        out = {k: _step[k] for k in _STEP_KEYS}
+        wall = max(time.time() - _step["t0"], 1e-9)
+    out["wall_s"] = wall
+    out["input_stall_fraction"] = min(out["input_wait_s"] / wall, 1.0)
+    out["host_wait_fraction"] = min(out["host_wait_s"] / wall, 1.0)
+    steps = max(out["steps"], 1)
+    out["host_syncs_per_step"] = out["metric_d2h"] / steps
+    return out
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):

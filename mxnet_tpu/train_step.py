@@ -22,6 +22,7 @@ the executor's NDArray buffers only at eval/checkpoint boundaries.
 """
 from __future__ import annotations
 
+import logging
 import pickle
 
 import numpy as np
@@ -91,6 +92,16 @@ class CompiledTrainStep:
         # compiled programs keyed by executor identity (the value holds a
         # strong ref to the executor so a GC'd id can't alias a new one);
         # a reshape rebuilds group.exec_, so the stale program is skipped
+        # device-side metric accumulation: when a DeviceMetricAccumulator is
+        # attached, its state rides the program as EXTRA DONATED STATE and
+        # the per-step device->host output read disappears (metric.py).
+        # _metric_traced_ids tracks which executors' programs have traced
+        # the metric successfully — per executor, because a shared store
+        # compiles one program per bucket and a later bucket's graph may
+        # still reject the metric's device mirror
+        self._metric_acc = None
+        self._metric_traced_ids = set()
+        self._metric_rejected = None  # metric whose device mirror failed
         self._fns = {}
         self._fn = self._build(exec_group)
         self._fns[id(exec_group.exec_)] = (self._fn, exec_group.exec_)
@@ -141,6 +152,43 @@ class CompiledTrainStep:
         return fn
 
     # ------------------------------------------------------------------
+    # device-side metrics
+    # ------------------------------------------------------------------
+    def attach_metric(self, metric):
+        """Fold ``metric``'s accumulation into the step program as donated
+        state.  Returns True when armed; False when this metric (or this
+        graph's label routing) can't accumulate on device — the caller then
+        stays on the host ``update_metric`` path.  Idempotent per metric."""
+        from .metric import DeviceMetricAccumulator
+
+        if self._metric_acc is not None and self._metric_acc.metric is metric:
+            return True
+        if metric is self._metric_rejected:
+            return False  # its device mirror already failed to trace once
+        if not DeviceMetricAccumulator.supported(metric):
+            return False
+        # the step only sees labels the graph consumes; if the iterator
+        # feeds extra labels the host pairing would differ — stay on host
+        if len(self._label_names) != len(self._group.label_names):
+            return False
+        self.detach_metric()
+        self._metric_acc = DeviceMetricAccumulator(metric)
+        self._metric_acc.install()
+        self._metric_traced_ids = set()
+        self._fns = {}  # program signature changed: recompile per executor
+        return True
+
+    def detach_metric(self):
+        """Drain pending device accumulation and drop the metric from the
+        program (fused->eager handoff, monitor installation, re-init)."""
+        if self._metric_acc is None:
+            return
+        self._metric_acc.uninstall()
+        self._metric_acc = None
+        self._metric_traced_ids = set()
+        self._fns = {}
+
+    # ------------------------------------------------------------------
     def _build(self, group):
         import jax
         import jax.numpy as jnp
@@ -151,6 +199,8 @@ class CompiledTrainStep:
         grad_names = self._grad_names
         aux_names = self._aux_names
         opt_apply = self._opt_apply
+        label_names = self._label_names
+        macc = self._metric_acc
 
         def cast(v):
             if cdtype is not None and jnp.issubdtype(v.dtype, jnp.floating):
@@ -164,8 +214,8 @@ class CompiledTrainStep:
                                 else jnp.float32)
             return v
 
-        def step(params, slots, aux, data, lrs, wds, rescale, clip, extra,
-                 rng):
+        def step(params, slots, aux, mstate, data, lrs, wds, rescale, clip,
+                 extra, rng):
             castp = {n: cast(v) for n, v in params.items()}
             # labels keep their dtype (integer class ids beyond bf16's exact
             # range must survive); only data inputs are cast
@@ -198,9 +248,14 @@ class CompiledTrainStep:
                     for s_new, s_old in zip(s, slots[n]))
             new_aux = {n: v.astype(aux[n].dtype)
                        for n, v in zip(aux_names, new_aux_vals)}
-            return new_params, new_slots, new_aux, outs
+            if macc is not None:
+                # metric accumulation reads the SAME outputs/labels the host
+                # path would; it feeds nothing back into the training math
+                labels = [data[n] for n in label_names]
+                mstate = macc.update(mstate, labels, list(outs))
+            return new_params, new_slots, new_aux, outs, mstate
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
     def run(self, data_batch, group=None):
@@ -247,9 +302,35 @@ class CompiledTrainStep:
             self._hyper_cache = (lrs, wds, rescale, clip, extra, dev)
             lrs, wds, rescale, clip, extra = dev
         rng = _rnd.split_key()
-        self.params, self.slots, self.aux, outs = fn(
-            self.params, self.slots, self.aux, data, lrs, wds, rescale, clip,
-            extra, rng)
+        acc = self._metric_acc
+        mstate = acc.state if acc is not None else ()
+        if acc is not None and id(group.exec_) not in self._metric_traced_ids:
+            # validate the metric's device mirror by TRACING ONLY
+            # (eval_shape executes nothing, so no donated buffer is at
+            # stake); a mirror that can't trace against this graph — shape
+            # pairing, unsupported op, ... — demotes the metric to the
+            # host path instead of failing the step.  Real execution
+            # errors below propagate untouched.
+            import jax
+
+            try:
+                jax.eval_shape(fn, self.params, self.slots, self.aux,
+                               mstate, data, lrs, wds, rescale, clip,
+                               extra, rng)
+                self._metric_traced_ids.add(id(group.exec_))
+            except Exception as exc:
+                logging.getLogger(__name__).info(
+                    "device metric accumulation unavailable (%s); metric "
+                    "stays on the host path", exc)
+                self._metric_rejected = acc.metric  # don't re-attach
+                self.detach_metric()
+                acc, mstate = None, ()
+                fn = self._entry_for(group)
+        self.params, self.slots, self.aux, outs, mstate = fn(
+            self.params, self.slots, self.aux, mstate, data, lrs, wds,
+            rescale, clip, extra, rng)
+        if acc is not None:
+            acc.commit(mstate)
         self.num_steps += 1
         return outs
 
@@ -295,12 +376,17 @@ class CompiledTrainStep:
             data[name] = jax.ShapeDtypeStruct(v.shape, v.dtype,
                                               sharding=sharding)
         lrs, wds, rescale, clip, extra = map(_aval, self._hyper_cache[5])
+        import jax.tree_util as jtu
+
+        mstate = () if self._metric_acc is None or \
+            self._metric_acc.state is None \
+            else jtu.tree_map(_aval, self._metric_acc.state)
         # peek the key chain for its aval — a probe must not advance the
         # global RNG (split_key() here would shift every later step's
         # randomness and break bit-reproducibility around the probe)
         rng = _aval(_rnd._key())
-        return fn.lower(params, slots, aux, data, lrs, wds, rescale, clip,
-                        extra, rng).compile().as_text()
+        return fn.lower(params, slots, aux, mstate, data, lrs, wds, rescale,
+                        clip, extra, rng).compile().as_text()
 
     def _place(self, arr, name, group=None):
         import jax

@@ -1,4 +1,11 @@
-"""Tier-1 smoke run of the long-context benchmark.
+"""Tier-1 smoke runs of the benchmarks.
+
+`bench.py --smoke` drives a small MLP fit through the FULL async training
+loop (device-side metrics + device prefetch + bounded in-flight dispatch)
+and must emit the loop-accounting fields `input_stall_fraction` and
+`host_syncs_per_step` alongside the metric contract.
+
+Tier-1 smoke run of the long-context benchmark.
 
 `benchmarks/bench_long_context.py --smoke` (tiny T, 8 virtual CPU
 devices) must stay importable and runnable on every PR: one JSON line on
@@ -13,6 +20,35 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_async_loop_contract():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # scrub inherited bench/loop knobs so the smoke measures the defaults
+    for key in [k for k in env if k.startswith("BENCH_")
+                or k in ("MXNET_DEVICE_METRICS", "MXNET_DEVICE_PREFETCH",
+                         "MXNET_MAX_STEPS_IN_FLIGHT",
+                         "MXNET_METRIC_SYNC_PERIOD")]:
+        env.pop(key)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    head = json.loads(lines[0])
+    # the bench.py metric contract ...
+    assert head["metric"].startswith("async_fit_mlp_imgs_per_sec")
+    assert head["unit"] == "img/s"
+    assert head["value"] > 0 and head["vs_baseline"] > 0
+    # ... plus the async-loop accounting fields, present and sane
+    assert 0.0 <= head["input_stall_fraction"] <= 1.0
+    assert head["host_syncs_per_step"] >= 0.0
+    # device-side accumulation means well under the 2-transfers-per-step
+    # (label + pred) floor of the synchronous host-metric loop
+    assert head["host_syncs_per_step"] < 1.0, head
 
 
 def test_bench_long_context_smoke_contract():

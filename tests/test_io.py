@@ -82,6 +82,57 @@ def test_prefetching_iter():
     assert len(list(pf)) == 5
 
 
+class _ExplodingIter(mx.io.DataIter):
+    """Yields ``good`` batches, then raises ValueError from the worker."""
+
+    def __init__(self, good=2):
+        super().__init__(batch_size=2)
+        self.good = good
+        self.n = 0
+        self.provide_data = [mx.io.DataDesc("data", (2, 2))]
+        self.provide_label = []
+
+    def reset(self):
+        self.n = 0
+
+    def next(self):
+        self.n += 1
+        if self.n > self.good:
+            raise ValueError("exploding iterator")
+        arr = mx.nd.array(np.full((2, 2), self.n, dtype=np.float32))
+        return mx.io.DataBatch([arr], [], pad=0)
+
+
+def test_prefetching_iter_propagates_worker_exception():
+    """A worker crash must re-raise in the consumer, not hang next()
+    forever (the old code swallowed everything but StopIteration)."""
+    pf = PrefetchingIter(_ExplodingIter(good=2))
+    got = [pf.next(), pf.next()]
+    assert len(got) == 2
+    with pytest.raises(ValueError, match="exploding"):
+        pf.next()
+    # the dead worker must not block subsequent calls either
+    with pytest.raises(StopIteration):
+        pf.next()
+    pf.close()
+
+
+def test_prefetching_iter_reset_under_load():
+    """reset() while the worker is blocked on a full-queue put must not
+    deadlock (stop-aware puts + a real close())."""
+    X = np.arange(200, dtype=np.float32).reshape(100, 2)
+    base = NDArrayIter(X, np.zeros(100, dtype=np.float32), batch_size=2)
+    pf = PrefetchingIter(base, capacity=1)
+    for _ in range(8):
+        pf.next()        # worker refills and blocks on the full queue
+        pf.reset()       # must join the blocked worker, not hang
+    assert len(list(pf)) == 50  # full epoch after the churn
+    pf.close()
+    pf.close()  # idempotent
+    with pytest.raises(StopIteration):  # closed: raise, don't block forever
+        pf.next()
+
+
 def test_recordio_roundtrip():
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "test.rec")

@@ -269,6 +269,8 @@ def test_pipeline_module_dropout_converges():
         context=[mx.cpu(i) for i in range(8)])
     it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=16)
     np.random.seed(9)
+    mx.random.seed(5)  # dropout masks draw from the global key chain; pin
+    # it so the trajectory doesn't depend on which tests ran before us
     pipe.fit(it, optimizer="sgd",
              optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
              initializer=mx.initializer.Xavier(), num_epoch=40,
