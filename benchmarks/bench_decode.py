@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Benchmark: KV-cached autoregressive decoding vs recompute-the-prefix.
+
+The serving-side headline the training benches never covered: an
+attention-LM generating tokens through ``mxnet_tpu.decode`` —
+
+* **prefill** — the (B, T) prompt pass that fills the ring-buffer KV
+  caches, reported as ``prefill_tokens_per_sec``;
+* **decode**  — the donated one-token-per-call step program, reported as
+  ``decode_tokens_per_sec``;
+* **naive**   — the recompute-the-prefix baseline: one full forward at the
+  bound (B, T) shape per generated token (what ``Predictor.forward``
+  generation costs), the O(T^2) plan the KV cache exists to beat;
+* **serve**   — the continuous-batching loop (``DecodeServer``): queued
+  requests admitted into fixed-shape slots, retired on max-len, slots
+  refilled — end-to-end served tokens/s including prefills.
+
+The bench also ASSERTS the O(1)-in-prefix property statically: dot FLOPs
+(``parallel.hlo_stats.dot_flops``) of the lowered decode-step program must
+not grow with the prefix, while the full-forward program's roughly double
+from T/2 to T — a failed assertion exits nonzero, so CI catches a decode
+path that silently regressed to re-running the prefix.
+
+Mirrors bench.py's contract: ONE json line on stdout —
+``{"metric": "decode_tokens_per_sec_t<T>", "value", "unit",
+"vs_baseline", ...}`` — where ``vs_baseline`` is the decode rate over the
+naive recompute rate on the same chip (the acceptance headline: >= 5x at
+T=512).  Per-phase detail goes to stderr, one json per line.
+
+Env knobs: BENCH_T, BENCH_BATCH, BENCH_EMBED, BENCH_HEADS, BENCH_VOCAB,
+BENCH_LAYERS, BENCH_DECODE_STEPS, BENCH_NAIVE_STEPS, BENCH_DTYPE.
+``--smoke``: the tier-1 CI entry — tiny dims on the forced-CPU platform
+(tests/test_bench_contract.py invokes it).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SMOKE = "--smoke" in sys.argv
+
+if SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # this image pre-imports jax with the TPU platform hook, so the env
+    # var alone can be read too late — pin the platform in code (same
+    # caveat as tests/conftest.py / docs/env_vars.md)
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from mxnet_tpu.decode import DecodePredictor, DecodeServer
+    from mxnet_tpu.models import attention_lm
+    from mxnet_tpu.parallel.hlo_stats import dot_flops
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    t = int(os.environ.get("BENCH_T", "64" if SMOKE else "512"))
+    b = int(os.environ.get("BENCH_BATCH", "2" if SMOKE else "4"))
+    e = int(os.environ.get("BENCH_EMBED",
+                           "32" if SMOKE else "1024" if on_tpu else "128"))
+    heads = int(os.environ.get("BENCH_HEADS", "4"))
+    vocab = int(os.environ.get("BENCH_VOCAB",
+                               "64" if SMOKE else
+                               "8192" if on_tpu else "256"))
+    layers = int(os.environ.get("BENCH_LAYERS", "2"))
+    n_decode = int(os.environ.get("BENCH_DECODE_STEPS",
+                                  "16" if SMOKE else "64"))
+    n_naive = int(os.environ.get("BENCH_NAIVE_STEPS", "4"))
+
+    sym = attention_lm.get_symbol(vocab_size=vocab, seq_len=t,
+                                  num_layers=layers, embed=e, heads=heads,
+                                  ffn_hidden=4 * e)
+
+    # random weights: generation quality is irrelevant to throughput
+    rng = np.random.RandomState(0)
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(b, t), softmax_label=(b, t))
+    params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params[name] = (rng.normal(0, 0.02, shape)).astype(np.float32)
+    for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+        params["aux:" + name] = np.zeros(shape, np.float32)
+
+    pred = DecodePredictor(sym, params, cache_len=t, temperature=0.0)
+
+    prompt_len = t // 2
+    prompts = rng.randint(0, vocab, size=(b, t)).astype(np.float32)
+    prompts[:, prompt_len:] = 0.0
+
+    key = jax.random.PRNGKey(0)
+
+    def emit(row):
+        print(json.dumps(row), file=sys.stderr, flush=True)
+
+    # ---- static FLOP accounting: the O(1)-in-prefix assertion ----------
+    state, _ = pred.prefill(prompts, prompt_len, key)
+    f_decode = dot_flops(pred.decode_step_text(state))
+    f_full = dot_flops(pred.prefill_text(b, t))
+    f_half = dot_flops(pred.prefill_text(b, t // 2))
+    # the decode-step program has no T-shaped input at all: its cost per
+    # token is a constant, while the recompute program's grows with the
+    # prefix (~2x from T/2 to T).  Both facts asserted from lowered HLO.
+    grow = f_full / max(f_half, 1)
+    per_tok_ratio = f_full / max(f_decode, 1)
+    emit({"phase": "flops", "decode_step_dot_flops": f_decode,
+          "full_forward_dot_flops_t%d" % t: f_full,
+          "full_forward_dot_flops_t%d" % (t // 2): f_half,
+          "full_growth": round(grow, 3),
+          "full_over_decode": round(per_tok_ratio, 1)})
+    assert grow >= 1.5, \
+        "full-forward FLOPs did not grow with prefix length (%.2f)" % grow
+    assert per_tok_ratio >= 4, \
+        "decode step FLOPs are not O(1) in the prefix (full/decode=%.1f)" \
+        % per_tok_ratio
+
+    # ---- prefill throughput --------------------------------------------
+    pred.prefill(prompts, prompt_len, key)  # compile
+    n_prefill = 2 if SMOKE else 5
+    tic = time.time()
+    for _ in range(n_prefill):
+        state, _ = pred.prefill(prompts, prompt_len, key)
+    jax.block_until_ready(state.caches)
+    prefill_tok_s = b * prompt_len * n_prefill / (time.time() - tic)
+    emit({"phase": "prefill", "tokens_per_sec": round(prefill_tok_s, 1),
+          "batch": b, "prompt_len": prompt_len})
+
+    # ---- decode throughput ---------------------------------------------
+    state, _ = pred.step(state, key)  # compile
+    tic = time.time()
+    for _ in range(n_decode):
+        state, _ = pred.step(state, key)
+        np.asarray(state.tok)  # the serving loop's per-step EOS read
+    decode_tok_s = b * n_decode / (time.time() - tic)
+    emit({"phase": "decode", "tokens_per_sec": round(decode_tok_s, 1),
+          "steps": n_decode, "cache_len": t})
+
+    # ---- naive recompute baseline --------------------------------------
+    # one full (B, T) forward per generated token, fixed shape (jitted
+    # once): exactly what generation through Predictor.forward costs
+    naive = prompts.copy()
+    cur = prompt_len
+    pred.prefill(naive, cur, key)  # compiled above; warm anyway
+    tic = time.time()
+    for _ in range(n_naive):
+        st, _ = pred.prefill(naive, cur, key)
+        tok = np.asarray(st.tok)
+        naive[:, cur] = tok[:, 0]
+        cur += 1
+    naive_tok_s = b * n_naive / (time.time() - tic)
+    emit({"phase": "naive", "tokens_per_sec": round(naive_tok_s, 1),
+          "steps": n_naive, "T": t})
+
+    # ---- continuous-batching serving loop ------------------------------
+    slots = 2 if SMOKE else 4
+    max_new = 8 if SMOKE else 32
+    server = DecodeServer(pred, max_prefill=t, slots=slots,
+                          max_new_tokens=max_new)
+    for i in range(2 * slots):
+        server.submit(rng.randint(0, vocab, size=(prompt_len,)))
+    tic = time.time()
+    results = server.run()
+    dt = time.time() - tic
+    serve_tok_s = server.tokens_out / dt
+    assert len(results) == 2 * slots and \
+        all(r.size == max_new for r in results.values())
+    emit({"phase": "serve", "tokens_per_sec": round(serve_tok_s, 1),
+          "requests": len(results), "slots": slots,
+          "decode_steps": server.steps})
+
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_t%d" % t,
+        "value": round(decode_tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(decode_tok_s / naive_tok_s, 3),
+        "prefill_tokens_per_sec": round(prefill_tok_s, 1),
+        "decode_tokens_per_sec": round(decode_tok_s, 1),
+        "serve_tokens_per_sec": round(serve_tok_s, 1),
+        "decode_step_dot_flops": f_decode,
+        "full_forward_dot_flops": f_full,
+    }))
+
+
+if __name__ == "__main__":
+    main()

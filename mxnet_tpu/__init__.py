@@ -54,6 +54,8 @@ from .model import FeedForward
 from . import predictor
 from . import rtc
 from .predictor import Predictor
+from . import decode
+from .decode import DecodePredictor, DecodeServer
 from . import rnn
 from . import parallel
 from . import checkpoint

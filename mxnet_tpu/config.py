@@ -171,6 +171,22 @@ register("MXNET_DEVICE_PREFETCH", bool, True,
          "device_put with the executor group's input sharding on a "
          "background thread while the current step runs.  0 = feed "
          "batches from the host thread as the reference does.")
+register("MXNET_DECODE_SLOTS", int, 8,
+         "Batch width of the continuous-batching serving loop "
+         "(decode.DecodeServer): the decode-step program always runs this "
+         "many in-flight sequence slots at a fixed shape, so admitting or "
+         "retiring a request never retraces.  Free slots refill from the "
+         "request queue after every step (Orca-style iteration-level "
+         "scheduling).")
+register("MXNET_DECODE_DONATE", bool, True,
+         "Donate the KV caches (and per-slot lengths) into the jitted "
+         "decode-step program so XLA appends in place — zero steady-state "
+         "allocation in the token loop.  0 keeps the inputs alive across "
+         "the call for debugging (inspect a cache mid-generation).")
+register("MXNET_DECODE_MAX_NEW", int, 256,
+         "Default cap on generated tokens per request in the serving loop "
+         "when the caller gives no explicit max_new_tokens (a sequence "
+         "with no EOS must retire eventually so its slot can refill).")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

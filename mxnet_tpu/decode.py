@@ -1,0 +1,545 @@
+"""KV-cached autoregressive decoding — prefill/decode split + batched serving.
+
+The ``Predictor`` runs a whole forward per call, so generating token T
+re-executes the full prefix: O(T^2) work per sequence.  This module is the
+TPU-era serving path (Pope et al., "Efficiently Scaling Transformer
+Inference"): :class:`DecodePredictor` splits an ``attention_lm``-style
+symbol into TWO jitted programs —
+
+* **prefill** — one full causal forward over the prompt that additionally
+  captures every ``dot_product_attention`` node's K/V into a preallocated
+  ring-buffer cache (``ops.attention.cache_append`` layout), and samples
+  the first output token;
+* **decode step** — one token per call: embed the last sampled token,
+  append its K/V at the next ring slot (``jax.lax.dynamic_update_slice``),
+  attend the single query position against the cache with a length-masked
+  softmax (``ops.attention.sdpa_decode``), sample the next token
+  (``ops.sample.sample_tokens``).  The program carries ``(params, state,
+  rng)`` with the state (caches + per-sequence lengths + last token)
+  DONATED (``MXNET_DECODE_DONATE``), so the token loop neither re-uploads
+  parameters, re-traces, nor allocates: O(1) work per token in the prefix
+  length.
+
+Under a mesh, parameters shard by the Megatron column/row plan
+(``parallel.tp_rules.plan_tensor_parallel``) and the caches' E (head) dim
+shards on 'model' (``parallel.tp_rules.kv_cache_pspec``): each model shard
+holds and scores only its own head group's cache slice — the inference-side
+counterpart of the training-side ring×TP composition.
+
+:class:`DecodeServer` is the batched serving loop: ``MXNET_DECODE_SLOTS``
+in-flight sequence slots at a FIXED batch shape (Orca-style continuous
+batching) — new requests prefill into a free slot between decode steps,
+sequences retire on EOS/max-len, and the freed slot refills from the
+request queue, all without retracing anything.
+
+The symbol contract (checked at trace time, documented in
+docs/inference.md): decoder-only graphs built from position-independent ops
+plus ``dot_product_attention`` for sequence mixing, with at most a learned
+positional table added via a ``broadcast_*`` op against a ``(1, S, E)``
+variable — ``models.attention_lm`` and the benchmark LMs qualify.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import NamedTuple
+
+import numpy as np
+
+from .base import MXNetError
+from . import context as ctx_mod
+from .registry import OpContext
+
+__all__ = ["DecodePredictor", "DecodeServer", "DecodeState"]
+
+# broadcast ops through which a (1, S, E) position table may meet the
+# (B, t, E) activation stream; the decode walk gathers the table rows for
+# the CURRENT positions before applying the op
+_POSITION_BROADCAST_OPS = {
+    "broadcast_add", "broadcast_plus", "broadcast_sub", "broadcast_minus",
+    "broadcast_mul",
+}
+
+
+class DecodeState(NamedTuple):
+    """The donated per-step serving state (a jax pytree)."""
+
+    caches: tuple       # ((k, v), ...) per attention node, each (B, C, E)
+    lens: object        # (B,) int32 — tokens appended to each cache so far
+    tok: object         # (B, 1) int32 — last sampled token, not yet appended
+
+
+class DecodePredictor:
+    """Incremental-decode executor for a trained attention LM.
+
+    Parameters
+    ----------
+    symbol : Symbol or str
+        The network — a Symbol, a JSON string, or a ``*-symbol.json`` path
+        (same forms as :class:`~mxnet_tpu.predictor.Predictor`).
+    params : dict, str, or bytes
+        Trained parameters (``arg:``/``aux:`` prefixes optional).
+    cache_len : int
+        Ring-buffer KV-cache length C per attention node.  Generation past
+        C tokens wraps: the cache keeps the latest C keys/values
+        (sliding-window attention).
+    ctx : Context, optional
+        Single-device placement; defaults to cpu.  Ignored when ``mesh``
+        is given.
+    mesh : jax.sharding.Mesh, optional
+        Shard parameters by the Megatron plan and KV caches on the
+        'model' (head) / 'data' (batch) axes.
+    temperature, top_k
+        Sampling knobs baked into the step program (0 = greedy).
+    data_name : str
+        The token-input variable; other free inputs (labels) are fed zeros.
+    """
+
+    def __init__(self, symbol, params, cache_len, ctx=None, mesh=None,
+                 temperature=0.0, top_k=0, data_name="data"):
+        import jax
+        import jax.numpy as jnp
+
+        from . import symbol as sym_mod
+        from .predictor import _as_param_dicts
+
+        if isinstance(symbol, str):
+            symbol = sym_mod.load_json(symbol) \
+                if symbol.lstrip().startswith("{") else sym_mod.load(symbol)
+        self._symbol = symbol
+        self._cache_len = int(cache_len)
+        if self._cache_len <= 0:
+            raise MXNetError("cache_len must be positive")
+        self._ctx = ctx if ctx is not None else ctx_mod.cpu()
+        self._mesh = mesh
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._data_name = data_name
+
+        arg_params, aux_params = _as_param_dicts(params)
+        free = [n for n in symbol.list_arguments() if n not in arg_params]
+        if data_name not in free:
+            raise MXNetError("%r is not a free input of the symbol (free "
+                             "inputs: %s)" % (data_name, free))
+        self._attn_nodes = [n for n in symbol._topo()
+                            if not n.is_variable
+                            and n.op.name == "dot_product_attention"]
+        if not self._attn_nodes:
+            raise MXNetError("symbol has no dot_product_attention node; "
+                             "nothing to cache — use Predictor")
+
+        self._cache_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .parallel.tp_rules import (kv_cache_pspec,
+                                            plan_tensor_parallel)
+
+            sizes = dict(mesh.shape)
+            model_par = sizes.get("model", 1)
+            rep = NamedSharding(mesh, P())
+            plan = plan_tensor_parallel(symbol) if model_par > 1 else {}
+
+            def place(name, arr):
+                spec = plan.get(name)
+                if spec is not None and len(spec) == len(arr.shape) and all(
+                        ax is None or arr.shape[d] % sizes.get(ax, 1) == 0
+                        for d, ax in enumerate(spec)):
+                    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+                return jax.device_put(arr, rep)
+
+            self._env = {n: place(n, a.data)
+                         for n, a in arg_params.items()}
+            self._env.update({n: jax.device_put(a.data, rep)
+                              for n, a in aux_params.items()})
+            self._cache_sharding = NamedSharding(
+                mesh, kv_cache_pspec(mesh.shape))
+            self._token_sharding = NamedSharding(
+                mesh, P("data" if sizes.get("data", 1) > 1 else None, None))
+        else:
+            dev = self._ctx.jax_device
+            self._env = {n: jax.device_put(a.data, dev)
+                         for n, a in arg_params.items()}
+            self._env.update({n: jax.device_put(a.data, dev)
+                              for n, a in aux_params.items()})
+            self._token_sharding = dev
+
+        from . import config as _config
+
+        donate = (1,) if _config.get("MXNET_DECODE_DONATE") else ()
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=donate)
+        self._prefill_fns = {}   # (B, P) -> jitted prefill program
+        # jnp dummies reused every call (sample_tokens at temperature 0
+        # never reads the key, but the jit signature keeps it)
+        self._zero_key = jax.random.PRNGKey(0)
+
+    @property
+    def cache_len(self):
+        return self._cache_len
+
+    # ------------------------------------------------------------------
+    # the shared graph walk (traced inside both programs)
+    # ------------------------------------------------------------------
+    def _run(self, env, tokens, caches, pos0):
+        """Execute the symbol on (B, t) tokens.
+
+        ``caches is None`` = prefill mode: full causal attention, fresh
+        ring buffers captured from each attention node's K/V.  Otherwise
+        decode mode: append K/V at ``pos0`` (per-sequence), length-masked
+        attention against the cache.  Returns ``(probs (B, t, V),
+        caches)``.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from .ops import attention as _attn
+
+        b, t = tokens.shape[0], tokens.shape[1]
+        new_caches = []
+        ci = 0
+        values = {}
+        base_key = jax.random.PRNGKey(0)
+        for seq, node in enumerate(self._symbol._topo()):
+            if node.is_variable:
+                if node.name == self._data_name:
+                    val = tokens
+                elif node.name in env:
+                    val = env[node.name]
+                else:
+                    # unfed free input (loss labels): zeros, forward-unused
+                    val = jnp.zeros((b, t), jnp.float32)
+                values[(id(node), 0)] = val
+                continue
+            attrs = node.parsed_attrs()
+            n_args = node.op.n_inputs(attrs)
+            ins = [values[(id(s), i)] for s, i in node.inputs[:n_args]]
+            aux_ins = [values[(id(s), i)] for s, i in node.inputs[n_args:]]
+            opname = node.op.name
+            if opname == "dot_product_attention":
+                q, k, v = ins
+                heads = attrs.get("num_heads", 1)
+                scale = attrs.get("scale", 0.0) or None
+                if caches is None:
+                    outs = [_attn.sdpa(q, k, v, num_heads=heads,
+                                       causal=attrs.get("causal", False),
+                                       scale=scale)]
+                    new_caches.append((self._fill_cache(k),
+                                       self._fill_cache(v)))
+                else:
+                    kc, vc = caches[ci]
+                    ci += 1
+                    kc = _attn.cache_append(kc, k, pos0)
+                    vc = _attn.cache_append(vc, v, pos0)
+                    pos = jnp.asarray(pos0, jnp.int32).reshape(-1)
+                    outs = [_attn.sdpa_decode(q, kc, vc, pos + t,
+                                              num_heads=heads, scale=scale)]
+                    new_caches.append((kc, vc))
+            else:
+                if opname in _POSITION_BROADCAST_OPS and len(ins) == 2 \
+                        and getattr(ins[0], "ndim", 0) == 3 \
+                        and getattr(ins[1], "ndim", 0) == 3 \
+                        and ins[0].shape[1] != ins[1].shape[1] \
+                        and t in (ins[0].shape[1], ins[1].shape[1]):
+                    # learned positional table vs the (B, t, E) stream:
+                    # gather the rows for the CURRENT positions
+                    big_i = 0 if ins[0].shape[1] != t else 1
+                    big = ins[big_i]
+                    if big.shape[0] != 1:
+                        raise MXNetError(
+                            "decode: node %r mixes time-lengths %s without "
+                            "a broadcastable (1, S, E) side" %
+                            (node.name, (ins[0].shape, ins[1].shape)))
+                    s_len = big.shape[1]
+                    idx = (jnp.asarray(pos0, jnp.int32).reshape(-1, 1)
+                           + jnp.arange(t, dtype=jnp.int32)[None, :])
+                    idx = jnp.clip(idx, 0, s_len - 1)
+                    ins = list(ins)
+                    ins[big_i] = jnp.take(big[0], idx, axis=0)
+                octx = OpContext(
+                    is_train=False,
+                    rng=jax.random.fold_in(base_key, seq),
+                    mesh_active=self._mesh is not None, mesh=self._mesh)
+                outs, _ = node.op.fcompute(attrs, ins, aux_ins, octx)
+            for i, o in enumerate(outs):
+                values[(id(node), i)] = o
+        head_node, head_idx = self._symbol._outputs[0]
+        out = values[(id(head_node), head_idx)]
+        if out.ndim == 2 and out.shape[0] == b * t:
+            out = out.reshape(b, t, -1)
+        elif out.ndim != 3:
+            raise MXNetError("decode: head output shape %s is not (B*t, V) "
+                             "or (B, t, V)" % (out.shape,))
+        return out, tuple(new_caches)
+
+    def _fill_cache(self, x):
+        """(B, t, E) prefill K/V -> a (B, C, E) ring buffer holding the t
+        tokens at their ``pos % C`` slots (prefill enforces t <= C)."""
+        import jax
+        import jax.numpy as jnp
+
+        b, t, e = x.shape
+        buf = jnp.zeros((b, self._cache_len, e), x.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, x, (0, 0, 0))
+        if self._cache_sharding is not None:
+            buf = jax.lax.with_sharding_constraint(buf, self._cache_sharding)
+        return buf
+
+    def _sample(self, key, probs):
+        import jax.numpy as jnp
+
+        from .ops.sample import sample_tokens
+
+        logits = jnp.log(probs.astype(jnp.float32) + 1e-30)
+        return sample_tokens(key, logits, self._temperature,
+                             self._top_k)[:, None]
+
+    # ------------------------------------------------------------------
+    # the two programs
+    # ------------------------------------------------------------------
+    def _prefill_impl(self, env, tokens, lens, key):
+        import jax.numpy as jnp
+
+        probs3, caches = self._run(env, tokens, None, 0)
+        # output at the last REAL prompt position, per sequence
+        last = jnp.clip(lens - 1, 0, tokens.shape[1] - 1)
+        probs = jnp.take_along_axis(
+            probs3, last[:, None, None], axis=1)[:, 0]
+        tok = self._sample(key, probs)
+        return DecodeState(caches, lens, tok), probs
+
+    def _decode_impl(self, env, state, key):
+        probs3, caches = self._run(env, state.tok, state.caches, state.lens)
+        probs = probs3[:, 0]
+        tok = self._sample(key, probs)
+        return DecodeState(caches, state.lens + 1, tok), probs
+
+    # ------------------------------------------------------------------
+    # public surface
+    # ------------------------------------------------------------------
+    def prefill(self, tokens, prompt_len=None, key=None):
+        """Process a (B, P) prompt batch once; returns ``(state, probs)``.
+
+        ``prompt_len`` (int or (B,)) marks the real length per row of a
+        padded batch — cache slots past it stay masked until decode
+        overwrites them.  ``probs`` is the model's (B, V) output at each
+        row's last real position; ``state.tok`` the sampled first token.
+        Jitted per (B, P) shape; repeated calls at one shape reuse the
+        compiled program (the serving loop's fixed-shape prefill).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        tokens = self._place_tokens(tokens)
+        b, p = tokens.shape
+        if p > self._cache_len:
+            # a wider window would have to wrap PADDED rows over real
+            # tokens for rows shorter than the window — refuse instead of
+            # silently attending pad K/V; bind a larger cache_len (decode
+            # itself may still wrap past it)
+            raise MXNetError("prompt width %d exceeds cache_len %d"
+                             % (p, self._cache_len))
+        if prompt_len is None:
+            prompt_len = p
+        lens = jnp.broadcast_to(
+            jnp.asarray(prompt_len, jnp.int32).reshape(-1), (b,))
+        fn = self._prefill_fns.get((b, p))
+        if fn is None:
+            fn = jax.jit(self._prefill_impl)
+            self._prefill_fns[(b, p)] = fn
+        return fn(self._env, tokens, lens,
+                  key if key is not None else self._zero_key)
+
+    def step(self, state, key=None):
+        """One decode step: append ``state.tok``'s K/V, attend, sample.
+
+        Returns ``(state', probs)`` with ``probs`` the (B, V) distribution
+        the new ``state'.tok`` was drawn from.  The input state is donated
+        (``MXNET_DECODE_DONATE``) — do not reuse it after the call.
+        """
+        return self._decode_fn(self._env, state,
+                               key if key is not None else self._zero_key)
+
+    def generate(self, tokens, prompt_len=None, max_new_tokens=16,
+                 seed=0, eos_id=None):
+        """Prefill + ``max_new_tokens`` decode steps; returns a (B, N)
+        int32 numpy array of sampled tokens (rows keep decoding past
+        their EOS — slice per row; the serving loop retires properly)."""
+        import jax
+
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        state, _ = self.prefill(tokens, prompt_len, sub)
+        out = [np.asarray(state.tok)]
+        done = (out[0][:, 0] == eos_id) if eos_id is not None else None
+        for _ in range(max_new_tokens - 1):
+            if done is not None and done.all():
+                break
+            key, sub = jax.random.split(key)
+            state, _ = self.step(state, sub)
+            out.append(np.asarray(state.tok))
+            if done is not None:
+                done |= out[-1][:, 0] == eos_id
+        return np.concatenate(out, axis=1)
+
+    def _place_tokens(self, tokens):
+        import jax
+
+        from .ndarray import NDArray
+
+        if isinstance(tokens, NDArray):
+            tokens = tokens.data
+        elif not isinstance(tokens, jax.Array):
+            tokens = np.asarray(tokens, np.float32)
+        return jax.device_put(tokens, self._token_sharding)
+
+    def decode_step_text(self, state, key=None):
+        """Lowered (pre-optimization) StableHLO of the decode-step program
+        at this state's shapes — feed to ``parallel.hlo_stats.dot_flops``
+        for the O(1)-in-prefix FLOP assertion (bench_decode.py)."""
+        return self._decode_fn.lower(
+            self._env, state,
+            key if key is not None else self._zero_key).as_text()
+
+    def prefill_text(self, b, p):
+        """Lowered StableHLO of the (b, p) prefill program — the
+        recompute-the-prefix cost baseline for the FLOP assertion."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
+        env = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for n, v in self._env.items()}
+        tokens = jax.ShapeDtypeStruct((b, p), jnp.float32)
+        lens = jax.ShapeDtypeStruct((b,), jnp.int32)
+        key = jax.ShapeDtypeStruct(self._zero_key.shape,
+                                   self._zero_key.dtype)
+        return fn.lower(env, tokens, lens, key).as_text()
+
+
+class DecodeServer:
+    """Continuous batching over a :class:`DecodePredictor`.
+
+    ``slots`` in-flight sequences decode as ONE fixed-shape batch; between
+    steps, finished sequences (EOS or per-request max-len) retire and free
+    slots refill from the request queue via a single-sequence prefill
+    spliced into the batch state with ``jax.lax.dynamic_update_slice``
+    (slot index traced, so admission never retraces).  Single-threaded by
+    design: the serving loop IS the schedule (Orca iteration-level
+    scheduling), callers queue requests with :meth:`submit` and drain with
+    :meth:`run`.
+    """
+
+    def __init__(self, predictor, max_prefill, slots=None, eos_id=None,
+                 max_new_tokens=None, seed=0):
+        from . import config as _config
+
+        self._pred = predictor
+        self._max_prefill = int(max_prefill)
+        if self._max_prefill > predictor.cache_len:
+            raise MXNetError("max_prefill %d exceeds the predictor's "
+                             "cache_len %d" % (self._max_prefill,
+                                               predictor.cache_len))
+        self._slots = int(slots or _config.get("MXNET_DECODE_SLOTS"))
+        self._eos_id = eos_id
+        self._max_new = int(max_new_tokens) if max_new_tokens is not None \
+            else int(_config.get("MXNET_DECODE_MAX_NEW"))
+        self._seed = seed
+        self._queue = deque()
+        self._next_id = 0
+        self._insert_fn = None
+        self.steps = 0          # decode steps executed (bench accounting)
+        self.tokens_out = 0     # tokens delivered to finished requests
+
+    def submit(self, tokens, max_new_tokens=None):
+        """Queue a prompt (1-D int sequence); returns the request id."""
+        tokens = np.asarray(tokens).reshape(-1)
+        if tokens.size > self._max_prefill:
+            raise MXNetError("prompt length %d exceeds max_prefill %d"
+                             % (tokens.size, self._max_prefill))
+        rid = self._next_id
+        self._next_id += 1
+        cap = int(max_new_tokens) if max_new_tokens is not None \
+            else self._max_new
+        self._queue.append((rid, tokens, cap))
+        return rid
+
+    # ------------------------------------------------------------------
+    def _build_insert(self):
+        import jax
+
+        from . import config as _config
+
+        donate = (0,) if _config.get("MXNET_DECODE_DONATE") else ()
+
+        def insert(state, one, slot):
+            import jax.numpy as jnp
+
+            slot = jnp.asarray(slot, jnp.int32)
+            zero = jnp.zeros((), jnp.int32)
+            caches = tuple(
+                (jax.lax.dynamic_update_slice(kc, nk, (slot, zero, zero)),
+                 jax.lax.dynamic_update_slice(vc, nv, (slot, zero, zero)))
+                for (kc, vc), (nk, nv) in zip(state.caches, one.caches))
+            lens = jax.lax.dynamic_update_slice(state.lens, one.lens,
+                                                (slot,))
+            tok = jax.lax.dynamic_update_slice(state.tok, one.tok,
+                                               (slot, zero))
+            return DecodeState(caches, lens, tok)
+
+        return jax.jit(insert, donate_argnums=donate)
+
+    def _empty_batch_state(self, one):
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+
+        b = self._slots
+        return jtu.tree_map(
+            lambda x: jnp.zeros((b,) + tuple(x.shape[1:]), x.dtype), one)
+
+    def run(self):
+        """Drain the queue; returns ``{request_id: np.int32 array}`` of
+        generated tokens (EOS included when hit)."""
+        import jax
+
+        key = jax.random.PRNGKey(self._seed)
+        state = None
+        active = {}     # slot -> [rid, tokens list, max_new]
+        results = {}
+        if self._insert_fn is None:
+            self._insert_fn = self._build_insert()
+
+        def retire():
+            for slot in list(active):
+                rid, toks, max_new = active[slot]
+                if (self._eos_id is not None and toks
+                        and toks[-1] == self._eos_id) \
+                        or len(toks) >= max_new:
+                    results[rid] = np.asarray(toks, np.int32)
+                    self.tokens_out += len(toks)
+                    del active[slot]
+
+        while self._queue or active:
+            # admit: prefill one request per free slot, splice into batch
+            while self._queue and len(active) < self._slots:
+                rid, prompt, max_new = self._queue.popleft()
+                padded = np.zeros((1, self._max_prefill), np.float32)
+                padded[0, :prompt.size] = prompt
+                key, sub = jax.random.split(key)
+                one, _ = self._pred.prefill(padded, prompt.size, sub)
+                if state is None:
+                    state = self._empty_batch_state(one)
+                slot = next(s for s in range(self._slots)
+                            if s not in active)
+                first = int(np.asarray(one.tok)[0, 0])
+                state = self._insert_fn(state, one, np.int32(slot))
+                active[slot] = [rid, [first], max_new]
+            retire()
+            if not active:
+                continue
+            key, sub = jax.random.split(key)
+            state, _ = self._pred.step(state, sub)
+            self.steps += 1
+            toks = np.asarray(state.tok)[:, 0]
+            for slot, rec in active.items():
+                rec[1].append(int(toks[slot]))
+            retire()
+        return results

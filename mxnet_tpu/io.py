@@ -547,14 +547,30 @@ class DevicePrefetchIter(_BackgroundIter):
 
             depth = _config.get("MXNET_PREFETCH_DEPTH")
         super().__init__(data_iter.batch_size, depth)
+        self.fallback_batches = 0  # batches passed through unplaced (bucketing)
         if placement is None:
             if module is None:
                 raise MXNetError("DevicePrefetchIter needs a bound module "
                                  "or an explicit placement function")
             placement = _module_placement(module)
-            self._names = lambda kind: (module._exec_group.data_names
-                                        if kind == "data"
-                                        else module._exec_group.label_names)
+
+            def _group():
+                g = getattr(module, "_exec_group", None)
+                if g is None:  # BucketingModule: the active bucket's group
+                    g = getattr(getattr(module, "_active", None),
+                                "_exec_group", None)
+                return g
+
+            def _names_from_module(kind):
+                group = _group()
+                if group is None:
+                    return [d.name for d in
+                            (self.data_iter.provide_data if kind == "data"
+                             else self.data_iter.provide_label or [])]
+                return group.data_names if kind == "data" \
+                    else group.label_names
+
+            self._names = _names_from_module
         else:
             self._names = lambda kind: [d.name for d in
                                         (self.data_iter.provide_data
@@ -583,8 +599,15 @@ class DevicePrefetchIter(_BackgroundIter):
 
     def _produce(self):
         batch = self.data_iter.next()
-        return DataBatch(data=self._place_list("data", batch.data),
-                         label=self._place_list("label", batch.label),
+        data = self._place_list("data", batch.data)
+        label = self._place_list("label", batch.label)
+        if any(p is a for p, a in zip(data, batch.data or [])) or \
+                any(p is a for p, a in zip(label, batch.label or [])):
+            # at least one array came back untouched: a shape-varying
+            # (bucketed) batch the bound executor doesn't describe — the
+            # consumer will place it per-bucket
+            self.fallback_batches += 1
+        return DataBatch(data=data, label=label,
                          pad=batch.pad, index=batch.index,
                          bucket_key=batch.bucket_key,
                          provide_data=batch.provide_data,
@@ -596,14 +619,35 @@ class DevicePrefetchIter(_BackgroundIter):
 
 def _module_placement(module):
     """Placement rule from a Module's executor group: cast to the bound
-    input dtype, then device_put with the group's input sharding."""
+    input dtype, then device_put with the group's input sharding.
+
+    Shape-varying (bucketed) batches fall back cleanly: the bound
+    executor — and the input sharding derived from it — describes ONE
+    bucket's shapes, so an array from a different bucket (or one the
+    executor doesn't know at all) is returned untouched and the consumer
+    places it per-bucket at step time, instead of committing it to a
+    stale sharding the compiled step would then have to undo (or worse,
+    crash on).  ``DevicePrefetchIter`` reports how often this happened in
+    ``fallback_batches`` so the ROADMAP "prefetch for bucketed iterators"
+    gap is observable, not silent.
+    """
 
     def place(kind, name, arr):
         import jax
 
-        group = module._exec_group
+        group = getattr(module, "_exec_group", None)
+        if group is None:        # BucketingModule: the active bucket's group
+            active = getattr(module, "_active", None)
+            group = getattr(active, "_exec_group", None)
+            if group is None:
+                return arr
         dst = group.exec_.arg_dict.get(name)
         v = arr.data if isinstance(arr, NDArray) else np.asarray(arr)
+        if dst is not None and tuple(v.shape) != tuple(dst.shape):
+            return arr           # different bucket: defer to the consumer
+        # dst None = graph-unconsumed input (extra label): still placed,
+        # with the group's input sharding, as before — only a SHAPE
+        # mismatch marks a bucketed batch
         if dst is not None and v.dtype != dst.data.dtype:
             v = v.astype(dst.data.dtype)
         if group._mesh is not None:

@@ -80,15 +80,41 @@ class BaseModule:
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        """Run an evaluation pass, returning the metric's name/value list."""
+        """Run an evaluation pass, returning the metric's name/value list.
+
+        Drivers with a compiled forward may arm device-side metric
+        accumulation (``_bind_eval_metric``): the whole pass then performs
+        no per-batch device→host transfer — the classic path materializes
+        label + pred on the host for every batch.  A metric/graph pair the
+        device path rejects falls back to the host path mid-loop with
+        everything already accumulated preserved.
+        """
         eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
+        eval_step = self._bind_eval_metric(eval_metric)
         nbatch = -1
-        for nbatch, batch in self._eval_batches(eval_data, num_batch, reset):
-            self.forward(batch, is_train=False)
-            self.update_metric(eval_metric, batch.label)
-            _fire(batch_end_callback,
-                  BatchEndParam(epoch, nbatch, eval_metric, locals()))
+        try:
+            for nbatch, batch in self._eval_batches(eval_data, num_batch,
+                                                    reset):
+                if eval_step is not None:
+                    try:
+                        eval_step.run(batch)
+                    except Exception as exc:
+                        # demote to the host path; device sums drain into
+                        # the metric so nothing accumulated is lost
+                        self.logger.info(
+                            "device-side eval metrics unavailable (%s); "
+                            "using the host path", exc)
+                        eval_step.finish()
+                        eval_step = None
+                if eval_step is None:
+                    self.forward(batch, is_train=False)
+                    self.update_metric(eval_metric, batch.label)
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch, nbatch, eval_metric, locals()))
+        finally:
+            if eval_step is not None:
+                eval_step.finish()
         _fire(score_end_callback,
               BatchEndParam(epoch, nbatch + 1, eval_metric, locals()))
         return eval_metric.get_name_value()
@@ -145,6 +171,12 @@ class BaseModule:
     def _bind_metric(self, eval_metric):
         """Give the driver a chance to fold ``eval_metric``'s accumulation
         into its compiled step (device-side metrics).  Default: host path."""
+
+    def _bind_eval_metric(self, eval_metric):
+        """Return a ``CompiledEvalStep``-like object (``run(batch)`` /
+        ``finish()``) accumulating ``eval_metric`` on device during
+        ``score``, or None for the classic host path.  Default: host."""
+        return None
 
     def _wrap_train_data(self, train_data):
         """Optionally wrap the training iterator (device prefetch).  The

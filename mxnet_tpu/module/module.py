@@ -566,6 +566,53 @@ class Module(BaseModule):
             self._fused_step.detach_metric()
         self._pending_metric = eval_metric
 
+    def _bind_eval_metric(self, eval_metric):
+        """Arm device-side metric accumulation for score(): the eval pass
+        runs one jitted forward+accumulate program per batch and never
+        materializes outputs on the host (ROADMAP PR-3 open item)."""
+        from .. import config as _config
+
+        if not _config.get("MXNET_DEVICE_METRICS"):
+            return None
+        if _config.get("MXNET_ENGINE_TYPE") == "NaiveEngine":
+            return None
+        if self._monitor is not None:
+            return None  # per-op taps need the eager executor path
+        if not self.binded or not self.params_initialized:
+            return None
+        from ..metric import DeviceMetricAccumulator
+
+        if not DeviceMetricAccumulator.supported(eval_metric):
+            return None
+        # fit() defaults validation_metric to the TRAIN metric instance,
+        # whose drain/reset hooks the fused step's accumulator owns;
+        # installing eval hooks over them (and uninstalling at pass end)
+        # would orphan the train-side device sums — such shared metrics
+        # score through the host path, as before
+        if any(getattr(m, "_device_sync", None) is not None
+               for m in DeviceMetricAccumulator._flatten(eval_metric)):
+            return None
+        # the program reads the executor's parameter buffers — bring them
+        # up to date with the fused step's master state first (forward()
+        # would have done the same)
+        self._flush_fused()
+        # one compiled eval step per (executor, metric) pair: repeated
+        # score() calls — fit's per-epoch validation — reuse it
+        cached = getattr(self, "_eval_step_cache", None)
+        if cached is not None and cached[0] is self._exec_group.exec_ \
+                and cached[1] is eval_metric:
+            return cached[2].rearm()
+        from ..train_step import CompiledEvalStep
+
+        try:
+            step = CompiledEvalStep(self._exec_group, eval_metric)
+        except MXNetError as exc:
+            self.logger.info("device-side eval metrics unavailable (%s); "
+                             "using the host path", exc)
+            return None
+        self._eval_step_cache = (self._exec_group.exec_, eval_metric, step)
+        return step
+
     def _wrap_train_data(self, train_data):
         from .. import config as _config
         from ..io import DevicePrefetchIter

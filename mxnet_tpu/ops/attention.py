@@ -55,6 +55,95 @@ def sdpa(q, k, v, num_heads=1, causal=False, scale=None):
     return out.reshape(b, tq, ev)
 
 
+# ---------------------------------------------------------------------------
+# Decode mode — incremental attention against a preallocated ring-buffer KV
+# cache (the Pope et al. "Efficiently Scaling Transformer Inference" decode
+# plan).  The full-sequence op above re-scores the whole prefix for every
+# generated token (O(T^2) per sequence); these kernels make decode O(T):
+# append the new K/V at the next ring slot, attend the query position(s)
+# against the cache with a length mask.  ``mxnet_tpu.decode`` drives them —
+# it splits an attention_lm-style symbol into a prefill program and a
+# donated decode-step program that calls cache_append + sdpa_decode at every
+# dot_product_attention node.  Under a mesh, the cache's E (head) dim is
+# sharded on 'model' (an E-split IS a head-group split — see
+# parallel/tp_rules.py) so each model shard holds and scores only its own
+# head group's cache slice.
+# ---------------------------------------------------------------------------
+
+def cache_append(cache, new, start_pos):
+    """Write ``new`` (B, t, E) into ring-buffer slots [start_pos,
+    start_pos+t) mod C of ``cache`` (B, C, E).
+
+    ``start_pos`` is the number of tokens already in the cache — a scalar
+    or a per-sequence (B,) vector (batched serving: each slot at its own
+    length).  The t == 1 decode hot path is a per-row
+    ``jax.lax.dynamic_update_slice`` (never wraps: one slot always fits);
+    multi-position appends scatter, wrapping modulo C so the cache keeps
+    the latest C tokens (sliding-window semantics — attention over a set
+    of keys is order-agnostic, positions having been added at the input
+    embedding).  Traceable; donated-safe (pure functional update).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, t, _ = new.shape
+    c = cache.shape[1]
+    start = jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32).reshape(-1),
+                             (b,))
+    new = new.astype(cache.dtype)
+    if t == 1:
+        slot = start % c
+        return jax.vmap(
+            lambda buf, row, s: jax.lax.dynamic_update_slice(
+                buf, row, (s, jnp.int32(0))))(cache, new, slot)
+    if t > c:
+        # only the latest C tokens can land; trimming BEFORE the scatter
+        # keeps the slot indices unique per row (scatter order with
+        # duplicate indices is backend-unspecified)
+        new = new[:, -c:]
+        start = start + (t - c)
+        t = c
+    pos = (start[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]) % c
+    return cache.at[jnp.arange(b)[:, None], pos].set(new)
+
+
+def sdpa_decode(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
+    """Attend query position(s) against a ring-buffer KV cache.
+
+    (B, tq, E) queries over (B, C, E)/(B, C, Ev) caches -> (B, tq, Ev).
+    ``total_len`` — scalar or (B,) — counts tokens appended to the cache
+    INCLUDING the query position(s): query i (the token at global position
+    ``total_len - tq + i``) sees cache slots j < min(total_len - tq + 1 + i,
+    C); once the ring has wrapped every slot holds a live token and the
+    window is all C slots.  Same fp32-softmax numerics as :func:`sdpa`, so
+    prefill+decode logits match the full forward pass.  With tq > 1 the
+    caller must not have wrapped past its own queries (t <= C).
+    """
+    import jax.numpy as jnp
+
+    b, tq, e = q.shape
+    c = k_cache.shape[1]
+    ev = v_cache.shape[2]
+    assert e % num_heads == 0 and ev % num_heads == 0, \
+        "embed dim not divisible by num_heads"
+    hd = e // num_heads
+    qh = q.reshape(b, tq, num_heads, hd)
+    kh = k_cache.reshape(b, c, num_heads, hd)
+    vh = v_cache.reshape(b, c, num_heads, ev // num_heads)
+    scale = scale or 1.0 / np.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+    total = jnp.asarray(total_len, jnp.int32).reshape(-1, 1, 1, 1)
+    qpos = jnp.arange(tq, dtype=jnp.int32).reshape(1, 1, tq, 1)
+    limit = jnp.minimum(total - (tq - 1) + qpos, c)
+    slot = jnp.arange(c, dtype=jnp.int32).reshape(1, 1, 1, c)
+    logits = jnp.where(slot < limit, logits, jnp.finfo(jnp.float32).min)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhe->bqhe", p.astype(vh.dtype), vh)
+    return out.reshape(b, tq, ev)
+
+
 # Which path the last dot_product_attention dispatch traced: "flash" or
 # "einsum".  Written at trace time (dispatch happens under jit tracing), so
 # tests can assert the kernel path actually ran instead of silently

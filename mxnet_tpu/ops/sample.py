@@ -19,6 +19,30 @@ def _shape_dtype(attrs, jnp):
     return shape, (jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt))
 
 
+def sample_tokens(key, logits, temperature=1.0, top_k=0):
+    """Draw token ids from ``(..., V)`` logits (or log-probabilities).
+
+    The decode-loop sampler (`mxnet_tpu.decode`): ``temperature == 0`` is
+    greedy argmax (``key`` unused — fully deterministic); otherwise logits
+    scale by ``1/temperature``, optionally truncate to the ``top_k``
+    largest (top-k sampling), and draw via ``jax.random.categorical``.
+    Traceable, so the whole sampler bakes into the jitted decode-step
+    program; determinism under a fixed PRNGKey comes from jax's counter-
+    based RNG.  Returns int32 ids with the leading logits dims.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if temperature == 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / float(temperature)
+    if top_k and 0 < top_k < logits.shape[-1]:
+        vals = jax.lax.top_k(scaled, top_k)[0]
+        kth = vals[..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
 def register_all():
     import jax
     import jax.numpy as jnp

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["collective_stats", "shape_bytes"]
+__all__ = ["collective_stats", "shape_bytes", "dot_flops"]
 
 _DTYPE_BYTES = {
     "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
@@ -115,6 +115,71 @@ def _start_bytes(op, shape_s):
     if len(parts) >= 2 and parts[0].startswith("("):
         return shape_bytes(parts[1])
     return sum(shape_bytes(p) for p in parts)
+
+
+# stablehlo: '%3 = stablehlo.dot_general %1, %2, batching_dims = [0] x [0],
+#   contracting_dims = [1] x [0] ... : (tensor<8x128xf32>, ...) -> tensor<...>'
+_SH_DOT_RE = re.compile(
+    r"dot_general\b.*?contracting_dims\s*=\s*\[([0-9,\s]*)\]\s*x\s*\[[0-9,\s]*\]"
+    r".*?:\s*\(tensor<([^>]+)>.*?->\s*tensor<([^>]+)>")
+# HLO: '%dot.3 = f32[8,512]{1,0} dot(f32[8,128]{1,0} %a, ...),
+#   lhs_contracting_dims={1}, rhs_contracting_dims={0}'
+_HLO_DOT_RE = re.compile(
+    r"=\s*([a-z][a-z0-9]+\[[0-9,]*\])\S*\s+dot\(\s*([a-z][a-z0-9]+\[[0-9,]*\])"
+    r".*?lhs_contracting_dims=\{([0-9,]*)\}")
+
+
+def _tensor_dims(spec):
+    """'2x4x64xf32' -> [2, 4, 64] (scalar 'f32' -> [])."""
+    return [int(d) for d in spec.split("x")[:-1]]
+
+
+def _bracket_dims(spec):
+    """'f32[8,128]' -> [8, 128]."""
+    inner = spec[spec.index("[") + 1:spec.index("]")]
+    return [int(d) for d in inner.split(",") if d]
+
+
+def dot_flops(program_text):
+    """Total matmul FLOPs (2 * result elements * contraction size) of every
+    dot in a lowered program — StableHLO ``dot_general`` and HLO ``dot(``
+    lines both count, fusion bodies included.
+
+    The decode benchmark's O(1)-in-prefix assertion rests on this: a
+    KV-cached decode step's dot FLOPs are a constant while the
+    recompute-the-prefix program's grow linearly with T.  Static counting
+    (like :func:`collective_stats`) — no execution, backend-independent
+    when fed ``jit(...).lower(...).as_text()``.
+    """
+    total = 0
+    for line in program_text.splitlines():
+        m = _SH_DOT_RE.search(line)
+        if m is not None:
+            cdims = [int(d) for d in m.group(1).replace(" ", "").split(",")
+                     if d]
+            lhs = _tensor_dims(m.group(2))
+            out = _tensor_dims(m.group(3))
+            contract = 1
+            for d in cdims:
+                contract *= lhs[d]
+            n = 1
+            for d in out:
+                n *= d
+            total += 2 * n * contract
+            continue
+        m = _HLO_DOT_RE.search(line)
+        if m is not None:
+            out = _bracket_dims(m.group(1))
+            lhs = _bracket_dims(m.group(2))
+            cdims = [int(d) for d in m.group(3).split(",") if d]
+            contract = 1
+            for d in cdims:
+                contract *= lhs[d]
+            n = 1
+            for d in out:
+                n *= d
+            total += 2 * n * contract
+    return total
 
 
 def collective_stats(hlo_text):

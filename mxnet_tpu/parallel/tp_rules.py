@@ -32,7 +32,7 @@ tests/test_tensor_parallel.py and tools/bandwidth.py.
 """
 from __future__ import annotations
 
-__all__ = ["plan_tensor_parallel", "ELEMENTWISE_OPS"]
+__all__ = ["plan_tensor_parallel", "kv_cache_pspec", "ELEMENTWISE_OPS"]
 
 # ops through which a feature-sharded activation stays feature-sharded
 # (their compute is pointwise over the sharded dim, or reduces other dims)
@@ -43,6 +43,25 @@ ELEMENTWISE_OPS = {
     "_plus_scalar", "_minus_scalar", "_mul_scalar", "_div_scalar",
     "_maximum", "_minimum", "clip", "identity", "BlockGrad", "stop_gradient",
 }
+
+
+def kv_cache_pspec(mesh_shape, batch_axis="data", head_axis="model"):
+    """PartitionSpec for a (B, C, E) decode KV cache on a mesh.
+
+    The Megatron invariant this module's plan rests on — an E-split IS a
+    head-group split (heads are contiguous hd-wide slices of E) — carries
+    straight to the cache: shard the trailing E dim on ``head_axis`` and
+    each model shard holds, appends to, and scores only its own head
+    group's K/V slice, with zero collectives in the decode step (the Pope
+    et al. inference sharding).  The ring-slot dim stays replicated
+    (appends index it dynamically); the batch dim shards on ``batch_axis``
+    so serving slots spread over the data axis.  Axes of size 1 drop out.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    sizes = dict(mesh_shape)
+    return P(batch_axis if sizes.get(batch_axis, 1) > 1 else None, None,
+             head_axis if sizes.get(head_axis, 1) > 1 else None)
 
 
 def plan_tensor_parallel(symbol):

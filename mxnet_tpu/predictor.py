@@ -29,7 +29,12 @@ from . import context as ctx_mod
 from . import ndarray as nd
 from . import symbol as sym_mod
 
-__all__ = ["Predictor", "load_exported"]
+__all__ = ["Predictor", "load_exported", "DecodePredictor", "DecodeServer"]
+
+
+def _shape_key(input_shapes):
+    """Canonical cache key for a set of bound input shapes."""
+    return tuple(sorted((n, tuple(s)) for n, s in input_shapes.items()))
 
 
 class Predictor:
@@ -100,9 +105,13 @@ class Predictor:
         self._exec.copy_params_from(arg_params, aux_params,
                                     allow_extra_params=True)
         self._outputs = None
-        self._jit_fn = None
         self._arg_params = arg_params
         self._aux_params = aux_params
+        # bound executors keyed by input shapes, SHARED with reshape()
+        # clones: flipping between shapes (bucketed serving) reuses the
+        # executor — and its per-shape jitted forward — instead of
+        # re-binding and re-compiling from scratch every time
+        self._bind_cache = {_shape_key(self._input_shapes): self._exec}
 
     # ------------------------------------------------------------------
     @classmethod
@@ -152,7 +161,9 @@ class Predictor:
 
     def reshape(self, input_shapes):
         """New Predictor bound to different input shapes, sharing weights
-        (``MXPredReshape``)."""
+        (``MXPredReshape``) AND the bind cache: reshaping back to a
+        previously-bound shape reuses that shape's executor and its jitted
+        forward instead of re-binding from scratch."""
         shapes = dict(self._input_shapes)
         shapes.update(input_shapes)
         clone = Predictor.__new__(Predictor)
@@ -163,12 +174,18 @@ class Predictor:
         clone._data_names = self._data_names
         clone._arg_params = self._arg_params
         clone._aux_params = self._aux_params
-        clone._exec = self._symbol.simple_bind(
-            self._ctx, grad_req="null", type_dict=self._type_dict, **shapes)
-        clone._exec.copy_params_from(self._arg_params, self._aux_params,
-                                     allow_extra_params=True)
+        clone._bind_cache = self._bind_cache
+        key = _shape_key(shapes)
+        exec_ = self._bind_cache.get(key)
+        if exec_ is None:
+            exec_ = self._symbol.simple_bind(
+                self._ctx, grad_req="null", type_dict=self._type_dict,
+                **shapes)
+            exec_.copy_params_from(self._arg_params, self._aux_params,
+                                   allow_extra_params=True)
+            self._bind_cache[key] = exec_
+        clone._exec = exec_
         clone._outputs = None
-        clone._jit_fn = None
         return clone
 
     # ------------------------------------------------------------------
@@ -245,6 +262,12 @@ def load_exported(blob_or_path):
         return exported.call(*data_vals)
 
     return run
+
+
+# incremental decoding (prefill/decode split, KV caches, batched serving) —
+# re-exported here so the deployment surface is one import, mirroring how
+# the reference groups every predict entry point in c_predict_api.h
+from .decode import DecodePredictor, DecodeServer  # noqa: E402
 
 
 def _as_param_dicts(params):

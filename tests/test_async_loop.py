@@ -414,3 +414,168 @@ def test_max_steps_in_flight_one_matches_default(loop_knobs):
     _, p8, _ = _fit(env8, loop_knobs, mx.metric.Accuracy())
     for name in p1:
         np.testing.assert_array_equal(p1[name], p8[name])
+
+
+def test_score_device_metrics_skip_per_batch_transfers(loop_knobs):
+    """PR-4 satellite (ROADMAP PR-3 open item): score() accumulates the
+    metric INSIDE a forward-only executor program — same values as the
+    host path, but the per-batch 2-transfer floor (label + pred) drops to
+    one accumulator drain for the whole pass."""
+    loop_knobs(SYNC_ENV)
+    X, y = _dataset()
+    it = NDArrayIter(X, y, batch_size=8)
+    mod = _mlp()
+    mod.fit(it, eval_metric="acc", num_epoch=1,
+            initializer=mx.initializer.Uniform(0.1), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    n_batches = len(X) // 8
+
+    loop_knobs({"MXNET_DEVICE_METRICS": "0"})
+    profiler.reset_step_stats()
+    host = dict(mod.score(it, mx.metric.create(["acc", "ce"])))
+    host_d2h = profiler.step_stats()["metric_d2h"]
+
+    loop_knobs({"MXNET_DEVICE_METRICS": "1"})
+    profiler.reset_step_stats()
+    dev = dict(mod.score(it, mx.metric.create(["acc", "ce"])))
+    dev_d2h = profiler.step_stats()["metric_d2h"]
+
+    assert host["accuracy"] == dev["accuracy"]
+    np.testing.assert_allclose(host["cross-entropy"], dev["cross-entropy"],
+                               rtol=1e-5)
+    assert host_d2h >= 2 * n_batches  # the classic per-batch floor
+    assert dev_d2h <= host_d2h / 2    # one batched drain, not per-batch
+    assert dev_d2h <= 8
+
+
+def test_score_device_metrics_reuse_compiled_step(loop_knobs):
+    """Scoring twice with the same metric reuses the compiled eval step
+    (fit's per-epoch validation must not recompile every epoch)."""
+    loop_knobs(SYNC_ENV)
+    loop_knobs({"MXNET_DEVICE_METRICS": "1"})
+    X, y = _dataset()
+    it = NDArrayIter(X, y, batch_size=8)
+    mod = _mlp()
+    mod.fit(it, eval_metric="acc", num_epoch=1,
+            initializer=mx.initializer.Uniform(0.1), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    metric = mx.metric.Accuracy()
+    first = dict(mod.score(it, metric))
+    step = mod._eval_step_cache[2]
+    second = dict(mod.score(it, metric))
+    assert mod._eval_step_cache[2] is step
+    assert first == second
+
+
+def test_score_unsupported_metric_stays_on_host(loop_knobs):
+    """A metric without a device mirror scores through the classic path,
+    values intact."""
+    loop_knobs(SYNC_ENV)
+    loop_knobs({"MXNET_DEVICE_METRICS": "1"})
+    X, y = _dataset()
+    it = NDArrayIter(X, y, batch_size=8)
+    mod = _mlp()
+    mod.fit(it, eval_metric="acc", num_epoch=1,
+            initializer=mx.initializer.Uniform(0.1), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    host_only = mx.metric.CustomMetric(
+        lambda label, pred: float((np.argmax(pred, 1) == label).mean()),
+        name="np_acc")
+    val = dict(mod.score(it, host_only))["np_acc"]
+    ref = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    np.testing.assert_allclose(val, ref, rtol=1e-6)
+
+
+def test_device_prefetch_falls_back_on_bucketed_batches(loop_knobs):
+    """PR-4 satellite: DevicePrefetchIter must not device_put a
+    shape-varying (bucketed) batch with the bound executor's stale
+    sharding — mismatching arrays pass through untouched (the consumer
+    places them per-bucket) and the fallback is counted, not silent."""
+    loop_knobs(ASYNC_ENV)
+    mod = _mlp()
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Uniform(0.1))
+
+    batches = [
+        DataBatch([mx.nd.array(np.full((8, 10), i, np.float32))],
+                  [mx.nd.array(np.zeros((8,), np.float32))])
+        if i != 1 else
+        DataBatch([mx.nd.array(np.full((4, 10), i, np.float32))],
+                  [mx.nd.array(np.zeros((4,), np.float32))])
+        for i in range(3)
+    ]
+
+    class TwoShapeIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(8)
+            self.i = 0
+
+        @property
+        def provide_data(self):
+            return [mx.io.DataDesc("data", (8, 10))]
+
+        @property
+        def provide_label(self):
+            return [mx.io.DataDesc("softmax_label", (8,))]
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= len(batches):
+                raise StopIteration
+            b = batches[self.i]
+            self.i += 1
+            return b
+
+    it = DevicePrefetchIter(TwoShapeIter(), module=mod)
+    try:
+        got = list(it)
+    finally:
+        it.close()
+    assert len(got) == 3
+    # the odd-shaped batch passed through identically; bound-shape batches
+    # were placed (fresh device-resident NDArrays)
+    assert got[1].data[0] is batches[1].data[0]
+    assert got[0].data[0] is not batches[0].data[0]
+    assert got[2].data[0] is not batches[2].data[0]
+    assert it.fallback_batches == 1
+    for i, b in enumerate(got):
+        assert float(b.data[0].asnumpy()[0, 0]) == float(i)
+
+
+def test_fit_validation_shares_train_metric_instance(loop_knobs):
+    """fit() defaults validation_metric to the TRAIN metric instance whose
+    drain hooks the fused step's accumulator owns; the eval device path
+    must not steal them — Train-* values stay real in every epoch.
+
+    Runs with boundary-only drains (MXNET_METRIC_SYNC_PERIOD=0, the
+    default): the epoch-end metric read then depends entirely on the
+    drain hook a hijacking eval pass would have nulled."""
+    import logging
+
+    loop_knobs(dict(ASYNC_ENV, MXNET_METRIC_SYNC_PERIOD="0"))
+    messages = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            messages.append(record.getMessage())
+
+    logger = logging.getLogger("test_fit_shared_metric")
+    logger.setLevel(logging.INFO)
+    logger.addHandler(Capture())
+    X, y = _dataset()
+    mod = _mlp()
+    mod.logger = logger
+    mx.random.seed(7)
+    mod.fit(NDArrayIter(X, y, batch_size=8),
+            eval_data=NDArrayIter(X, y, batch_size=8),
+            eval_metric="acc", num_epoch=3,
+            initializer=mx.initializer.Uniform(0.1), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    train_lines = [m for m in messages if "Train-accuracy" in m]
+    assert len(train_lines) == 3
+    for line in train_lines:
+        val = float(line.rsplit("=", 1)[1])
+        assert np.isfinite(val) and 0.0 < val <= 1.0, train_lines

@@ -13,6 +13,15 @@ stdout under the bench.py contract, per-(mesh, schedule) detail JSONs on
 stderr covering BOTH ring communication schedules (serial and
 double-buffered), with collective traffic accounted from compiled HLO.
 A broken bench would otherwise only surface on the TPU rig.
+
+Tier-1 smoke run of the decode benchmark.
+
+`benchmarks/bench_decode.py --smoke` drives the KV-cached serving path
+(prefill program, donated decode-step program, recompute baseline,
+continuous-batching server) at tiny dims and must emit the bench.py
+metric contract plus the decode accounting fields — including the
+HLO-level dot-FLOP counts behind the O(1)-in-prefix assertion, which the
+bench itself enforces (nonzero exit on regression).
 """
 import json
 import os
@@ -93,3 +102,40 @@ def test_bench_long_context_smoke_contract():
         assert over["collective_bytes"] == \
             by_key[(mesh, "serial")]["collective_bytes"]
     assert by_key[("tp", "n/a")]["attention_path"] == "einsum"
+
+
+def test_bench_decode_smoke_contract():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # scrub inherited bench/decode knobs so the smoke measures the defaults
+    for key in [k for k in env if k.startswith("BENCH_")
+                or k.startswith("MXNET_DECODE_")]:
+        env.pop(key)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "bench_decode.py"), "--smoke"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    # stdout: exactly one JSON line, the bench.py metric contract plus the
+    # decode accounting fields
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    head = json.loads(lines[0])
+    assert head["metric"].startswith("decode_tokens_per_sec_t")
+    assert head["unit"] == "tok/s"
+    assert head["value"] > 0
+    # cached decode must beat recompute-the-prefix even at smoke dims
+    assert head["vs_baseline"] > 1.0, head
+    for key in ("prefill_tokens_per_sec", "decode_tokens_per_sec",
+                "serve_tokens_per_sec", "decode_step_dot_flops",
+                "full_forward_dot_flops"):
+        assert key in head and head[key] > 0, (key, head)
+    # the statically-counted O(1)-in-prefix relation the bench asserts
+    assert head["decode_step_dot_flops"] * 4 <= head["full_forward_dot_flops"]
+
+    # stderr: one JSON per phase, all phases present
+    rows = [json.loads(ln) for ln in proc.stderr.splitlines()
+            if ln.strip().startswith("{")]
+    phases = {r.get("phase") for r in rows}
+    assert {"flops", "prefill", "decode", "naive", "serve"} <= phases, phases
