@@ -45,6 +45,16 @@ PEAK_FLOPS = {
 }
 
 
+def contract_line(metric, value, unit, vs_baseline, **extra):
+    """The one-line stdout JSON contract every bench emits — and now the
+    analysis CLI too (tools/mxlint.py), so CI consumes one schema:
+    {"metric", "value", "unit", "vs_baseline", ...extras}."""
+    row = {"metric": metric, "value": value, "unit": unit,
+           "vs_baseline": vs_baseline}
+    row.update(extra)
+    return json.dumps(row)
+
+
 def _peak_for(device):
     kind = getattr(device, "device_kind", "")
     for name, peak in PEAK_FLOPS.items():
@@ -215,14 +225,11 @@ def main():
     metric = "resnet50_train_imgs_per_sec_bs%d" % batch_size
     if use_recordio:
         metric = "resnet50_recordio_train_imgs_per_sec_bs%d" % batch_size
-    print(json.dumps({
-        "metric": metric,
-        "value": round(img_s, 2),
-        "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-        "input_stall_fraction": round(stats["input_stall_fraction"], 4),
-        "host_syncs_per_step": round(stats["host_syncs_per_step"], 4),
-    }))
+    print(contract_line(
+        metric, round(img_s, 2), "img/s",
+        round(img_s / BASELINE_IMG_S, 3),
+        input_stall_fraction=round(stats["input_stall_fraction"], 4),
+        host_syncs_per_step=round(stats["host_syncs_per_step"], 4)))
 
 
 def smoke():
@@ -263,14 +270,11 @@ def smoke():
                                       "metric_d2h", "metric_syncs")}}),
           file=sys.stderr)
     n = max(stats["steps"], 1)
-    print(json.dumps({
-        "metric": "async_fit_mlp_imgs_per_sec_bs%d" % batch,
-        "value": round(batch * n / (toc - tic), 2),
-        "unit": "img/s",
-        "vs_baseline": 1.0,
-        "input_stall_fraction": round(stats["input_stall_fraction"], 4),
-        "host_syncs_per_step": round(stats["host_syncs_per_step"], 4),
-    }))
+    print(contract_line(
+        "async_fit_mlp_imgs_per_sec_bs%d" % batch,
+        round(batch * n / (toc - tic), 2), "img/s", 1.0,
+        input_stall_fraction=round(stats["input_stall_fraction"], 4),
+        host_syncs_per_step=round(stats["host_syncs_per_step"], 4)))
 
 
 if __name__ == "__main__":

@@ -58,6 +58,7 @@ from . import decode
 from .decode import DecodePredictor, DecodeServer
 from . import rnn
 from . import parallel
+from . import analysis
 from . import checkpoint
 from . import profiler
 from . import visualization

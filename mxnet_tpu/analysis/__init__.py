@@ -1,0 +1,78 @@
+"""Static program analysis over jaxprs, lowered StableHLO and compiled HLO.
+
+The framework's performance headlines are *program-level invariants* —
+fewer collective bytes than the GSPMD baseline, donated buffers aliased in
+place, zero host syncs per step, O(1)-in-prefix decode FLOPs, one trace
+per program shape.  This package turns each into a static audit that runs
+on the 8-virtual-device CPU mesh, so a sharding-rule edit or a jit
+cache-key drift fails CI instead of waiting for the TPU rig:
+
+* :mod:`~mxnet_tpu.analysis.hlo_parse` — the text parsing layer (grown
+  out of ``parallel/hlo_stats.py``, which re-exports it);
+* :mod:`~mxnet_tpu.analysis.artifact` — :class:`ProgramArtifact`, one
+  canonical program's jaxpr/StableHLO/HLO surfaces + metadata;
+* :mod:`~mxnet_tpu.analysis.framework` — :class:`Pass`,
+  :class:`Finding`, suppression matching and :func:`run_passes`;
+* :mod:`~mxnet_tpu.analysis.passes` — the five shipped passes (donation,
+  collective budget, retrace, host sync, FLOP/dtype);
+* :mod:`~mxnet_tpu.analysis.retrace` — :class:`RetraceAuditor` for
+  instrumenting arbitrary jitted functions;
+* :mod:`~mxnet_tpu.analysis.programs` — builders for the five canonical
+  programs ``tools/mxlint.py`` audits.
+
+Entry point: ``tools/mxlint.py`` (CLI, bench JSON contract, ``--smoke``
+tier-1 hook); library use::
+
+    from mxnet_tpu import analysis
+    report = analysis.run_passes([module.program_artifacts()["train_step"]],
+                                 budgets=analysis.load_budgets())
+    assert report.ok(), report.format_text()
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .artifact import ProgramArtifact, artifact_from_jit
+from .framework import (Finding, Pass, Report, SEVERITIES, default_passes,
+                        run_passes)
+from .passes import (CollectiveBudgetPass, DonationPass, FlopDtypePass,
+                     HostSyncPass, RetracePass)
+from .retrace import RetraceAuditor, arg_signature, signature_diff
+
+__all__ = [
+    "CollectiveBudgetPass", "DonationPass", "Finding", "FlopDtypePass",
+    "HostSyncPass", "Pass", "ProgramArtifact", "Report", "RetraceAuditor",
+    "RetracePass", "SEVERITIES", "arg_signature", "artifact_from_jit",
+    "default_passes", "load_budgets", "resolve_budgets_path", "run_passes",
+    "signature_diff",
+]
+
+_DEFAULT_BUDGETS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "benchmarks", "budgets.json")
+
+
+def resolve_budgets_path(path=None):
+    """The budget file location: explicit ``path`` argument, the
+    ``MXNET_ANALYSIS_BUDGETS`` env knob, the repo default — the ONE
+    resolution rule, shared by :func:`load_budgets` and
+    ``tools/mxlint.py --update-budgets`` so reads and writes cannot
+    diverge."""
+    from .. import config as _config
+
+    return path or _config.get("MXNET_ANALYSIS_BUDGETS") or _DEFAULT_BUDGETS
+
+
+def load_budgets(path=None):
+    """Parse the committed budget file (``benchmarks/budgets.json``).
+
+    Resolved via :func:`resolve_budgets_path`.  A missing file returns
+    ``{}`` — the budget pass then reports per-program "no committed
+    budget" findings rather than crashing.
+    """
+    path = resolve_budgets_path(path)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)

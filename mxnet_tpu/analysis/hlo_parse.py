@@ -1,0 +1,391 @@
+"""Static parsing of lowered StableHLO and compiled HLO text.
+
+The parsing layer of the analysis pass framework — grown out of
+``parallel/hlo_stats.py`` (which now re-exports from here): under XLA the
+collectives, dots and buffer-donation aliases are explicit in the program
+text, so every performance invariant the framework establishes (collective
+budgets, O(1)-in-prefix decode FLOPs, donation round-trips) is *statically*
+checkable from ``jit(...).lower(...)`` output, no accelerator required.
+
+Three families of entry points:
+
+* byte accounting — :func:`shape_bytes` / :func:`shape_bytes_report` /
+  :func:`collective_stats`;
+* FLOP accounting — :func:`dot_flops` / :func:`dot_flops_report` (the
+  report carries ``uncounted_ops`` so dot-like ops the counter cannot
+  parse are a signal, not a silent zero);
+* program metadata — :func:`input_output_aliases` (compiled-HLO donation
+  aliasing).
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = [
+    "collective_stats",
+    "dot_flops",
+    "dot_flops_report",
+    "input_output_aliases",
+    "shape_bytes",
+    "shape_bytes_report",
+]
+
+# Bit widths per HLO/StableHLO element type.  Sub-byte types (s4/u4, the
+# fp4/fp8 menagerie) are sized in bits and rounded up per-shape, matching
+# XLA's packed layouts closely enough for budget accounting.
+_DTYPE_BITS = {
+    "f64": 64, "f32": 32, "f16": 16, "bf16": 16,
+    "f8e4m3": 8, "f8e4m3fn": 8, "f8e4m3fnuz": 8, "f8e4m3b11fnuz": 8,
+    "f8e5m2": 8, "f8e5m2fnuz": 8, "f8e3m4": 8, "f8e8m0fnu": 8,
+    "f4e2m1fn": 4,
+    "s64": 64, "u64": 64, "s32": 32, "u32": 32, "s16": 16, "u16": 16,
+    "s8": 8, "u8": 8, "s4": 4, "u4": 4, "s2": 2, "u2": 2,
+    "pred": 8, "c64": 64, "c128": 128,
+}
+
+# dtype-shaped names only — 'pred', 'bf16', or letter-digit-led tokens
+# like f32/s4/u8/c64/f8e4m3fn — so identifier[index] strings in HLO
+# metadata (op_name="params[0]", arg names) never read as shapes
+_SHAPE_RE = re.compile(r"\b(pred|bf16|[fsuc][0-9][0-9a-z]*)\[([0-9,]*)\]")
+
+# an instruction line: '%name = SHAPE op(...)'.  SHAPE is extracted with a
+# balanced-paren scan, not a depth-limited regex: tuple shapes nest (grouped
+# async collectives carry tuples of buffers) and TPU layout annotations like
+# {1,0:T(8,128)} add parens at arbitrary depth inside them.
+_INSTR_RE = re.compile(r"=\s*")
+_OP_RE = re.compile(
+    r"\s*(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(-start|-done)?\(")
+
+def _scan_shape(line, start):
+    """Return (shape_str, end_index) for the shape beginning at `start` —
+    either a balanced parenthesized tuple or a single whitespace-free
+    token."""
+    if start < len(line) and line[start] == "(":
+        depth = 0
+        for i in range(start, len(line)):
+            if line[i] == "(":
+                depth += 1
+            elif line[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    return line[start:i + 1], i + 1
+        return line[start:], len(line)
+    m = re.match(r"\S+", line[start:])
+    if m is None:
+        return "", start
+    return m.group(0), start + m.end()
+
+
+def shape_bytes_report(shape_str):
+    """(total_bytes, unknown_dtypes) over every 'dtype[dims]' shape in the
+    string (tuples ok).  Element types missing from the width table land in
+    ``unknown_dtypes`` (sorted, deduped) instead of silently contributing
+    zero — the analysis FLOP/byte passes turn a non-empty list into a
+    recorded finding."""
+    total = 0
+    unknown = set()
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        bits = _DTYPE_BITS.get(dtype)
+        if bits is None:
+            unknown.add(dtype)
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += (n * bits + 7) // 8
+    return total, sorted(unknown)
+
+
+def shape_bytes(shape_str):
+    """Total bytes of every 'dtype[dims]' shape in the string (tuples ok).
+    Unknown dtypes contribute zero here — use :func:`shape_bytes_report`
+    when the caller needs them surfaced."""
+    return shape_bytes_report(shape_str)[0]
+
+
+def _split_top_level(tuple_str):
+    """Split '(a, (b, c), d)' into top-level elements ['a', '(b, c)', 'd']."""
+    s = tuple_str.strip()
+    if not (s.startswith("(") and s.endswith(")")):
+        return [s]
+    s = s[1:-1]
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return [p.strip() for p in parts if p.strip()]
+
+
+def _start_bytes(op, shape_s):
+    """Result payload of an async '-start' tuple shape.
+
+    The tuple layout is op-specific (verified against compiled HLO):
+    ``all-reduce-start`` has the SAME shape as the sync op — a flat tuple
+    of results when XLA combined several all-reduces — so every buffer
+    counts.  ``all-gather-start`` / ``reduce-scatter-start`` /
+    ``collective-permute-start`` carry
+    ``(operand(s), result(s), [u32 context scalars...])`` — count only
+    the result element (itself possibly a tuple for grouped ops).
+    Summing naively would double those (reduce-scatter-start used to fall
+    into the generic fallback and did exactly that, inflating absolute
+    KiB/step); taking the single largest buffer (the old rule)
+    undercounts any grouped form.
+    """
+    parts = _split_top_level(shape_s)
+    parts = [p for p in parts
+             if not re.fullmatch(r"[su]32\[\]\S*", p)]  # context scalars
+    if not parts:
+        return 0
+    if op == "all-reduce":
+        return sum(shape_bytes(p) for p in parts)
+    if op in ("all-gather", "reduce-scatter", "collective-permute") \
+            and len(parts) >= 2:
+        return shape_bytes(parts[1])
+    # generic async wrapper: ((operands...), results, ctx) — a leading
+    # tuple element marks the operand pack; otherwise flat results
+    if len(parts) >= 2 and parts[0].startswith("("):
+        return shape_bytes(parts[1])
+    return sum(shape_bytes(p) for p in parts)
+
+
+# stablehlo: '%3 = stablehlo.dot_general %1, %2, batching_dims = [0] x [0],
+#   contracting_dims = [1] x [0] ... : (tensor<8x128xf32>, ...) -> tensor<...>'
+_SH_DOT_GENERAL_RE = re.compile(
+    r"dot_general\b.*?contracting_dims\s*=\s*"
+    r"\[([0-9,\s]*)\]\s*x\s*\[[0-9,\s]*\]"
+    r".*?:\s*\(tensor<([^>]+)>.*?->\s*tensor<([^>]+)>")
+# stablehlo non-general dot: '%3 = stablehlo.dot %1, %2 {...} :
+#   (tensor<8x128xf32>, tensor<128x32xf32>) -> tensor<8x32xf32>' — matrix /
+#   matrix-vector / dot-product semantics: the contraction is always the
+#   lhs LAST dimension against the rhs first.
+_SH_DOT_RE = re.compile(
+    r"stablehlo\.dot\s+[^:]*:\s*\(tensor<([^>]+)>\s*,\s*tensor<([^>]+)>\s*\)"
+    r"\s*->\s*tensor<([^>]+)>")
+# HLO: '%dot.3 = f32[8,512]{1,0} dot(f32[8,128]{1,0} %a, ...),
+#   lhs_contracting_dims={1}, rhs_contracting_dims={0}'
+_HLO_DOT_RE = re.compile(
+    r"=\s*([a-z][a-z0-9]+\[[0-9,]*\])\S*\s+dot\(\s*([a-z][a-z0-9]+\[[0-9,]*\])"
+    r".*?lhs_contracting_dims=\{([0-9,]*)\}")
+# dot-like ops the counter knows it does NOT model: any appearance goes to
+# the report's uncounted_ops so a program using them cannot silently read
+# as zero FLOPs.  HLO 'dot(' lines missing contracting-dims metadata and
+# unparseable stablehlo dot forms are appended dynamically.
+_UNCOUNTED_RE = re.compile(
+    r"(stablehlo\.convolution\b"
+    r"|(?<![-\w])convolution\("
+    r"|stablehlo\.dot_general\b"
+    r"|stablehlo\.dot\b"
+    r"|(?<![-\w.])dot\()")
+_UNCOUNTED_NAMES = {
+    "stablehlo.convolution": "stablehlo.convolution",
+    "convolution(": "convolution",
+    "stablehlo.dot_general": "stablehlo.dot_general",
+    "stablehlo.dot": "stablehlo.dot",
+    "dot(": "dot",
+}
+
+
+def _tensor_dims(spec):
+    """'2x4x64xf32' -> [2, 4, 64] (scalar 'f32' -> [])."""
+    return [int(d) for d in spec.split("x")[:-1]]
+
+
+def _tensor_dtype(spec):
+    """'2x4x64xf32' -> 'f32'."""
+    return spec.split("x")[-1]
+
+
+def _bracket_dims(spec):
+    """'f32[8,128]' -> [8, 128]."""
+    inner = spec[spec.index("[") + 1:spec.index("]")]
+    return [int(d) for d in inner.split(",") if d]
+
+
+def _bracket_dtype(spec):
+    """'f32[8,128]' -> 'f32'."""
+    return spec[:spec.index("[")]
+
+
+def _prod(dims):
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def dot_flops_report(program_text):
+    """Structured matmul-FLOP accounting of a lowered program.
+
+    Returns ``{"flops": int, "dots": [...], "uncounted_ops": [...]}``:
+
+    * ``flops`` — total 2 * result elements * contraction size over every
+      parsed dot (StableHLO ``dot_general`` and non-general ``dot``, HLO
+      ``dot(`` lines; fusion bodies included);
+    * ``dots`` — one record per parsed line: ``{"op", "dtype"
+      (result element type), "flops", "line"}`` — the dtype-lint pass
+      reads these to flag f32 dots inside bf16 programs;
+    * ``uncounted_ops`` — dot-like ops the counter saw but could not
+      model (``convolution`` in either dialect, malformed dot lines),
+      as ``{"op", "count"}`` aggregates.  A non-empty list means
+      ``flops`` is a floor, not a total — the FLOP-coverage pass turns
+      it into an error.
+    """
+    total = 0
+    dots = []
+    uncounted = {}
+
+    def _count_uncounted(name):
+        uncounted[name] = uncounted.get(name, 0) + 1
+
+    for line in program_text.splitlines():
+        m = _SH_DOT_GENERAL_RE.search(line)
+        if m is not None:
+            cdims = [int(d) for d in m.group(1).replace(" ", "").split(",")
+                     if d]
+            lhs = _tensor_dims(m.group(2))
+            out = _tensor_dims(m.group(3))
+            flops = 2 * _prod(out) * _prod(lhs[d] for d in cdims)
+            total += flops
+            dots.append({"op": "stablehlo.dot_general",
+                         "dtype": _tensor_dtype(m.group(3)),
+                         "flops": flops, "line": line.strip()})
+            continue
+        m = _SH_DOT_RE.search(line)
+        if m is not None:
+            lhs = _tensor_dims(m.group(1))
+            out = _tensor_dims(m.group(3))
+            # stablehlo.dot contracts lhs's last dim; a scalar-shaped lhs
+            # (pure dot product) contracts its only dim
+            contract = lhs[-1] if lhs else 1
+            flops = 2 * _prod(out) * contract
+            total += flops
+            dots.append({"op": "stablehlo.dot",
+                         "dtype": _tensor_dtype(m.group(3)),
+                         "flops": flops, "line": line.strip()})
+            continue
+        m = _HLO_DOT_RE.search(line)
+        if m is not None:
+            out = _bracket_dims(m.group(1))
+            lhs = _bracket_dims(m.group(2))
+            cdims = [int(d) for d in m.group(3).split(",") if d]
+            flops = 2 * _prod(out) * _prod(lhs[d] for d in cdims)
+            total += flops
+            dots.append({"op": "dot", "dtype": _bracket_dtype(m.group(1)),
+                         "flops": flops, "line": line.strip()})
+            continue
+        m = _UNCOUNTED_RE.search(line)
+        if m is not None:
+            _count_uncounted(_UNCOUNTED_NAMES[m.group(1)])
+    return {
+        "flops": total,
+        "dots": dots,
+        "uncounted_ops": [{"op": k, "count": v}
+                          for k, v in sorted(uncounted.items())],
+    }
+
+
+def dot_flops(program_text):
+    """Total matmul FLOPs (2 * result elements * contraction size) of every
+    dot in a lowered program — StableHLO ``dot_general`` / ``dot`` and HLO
+    ``dot(`` lines all count, fusion bodies included.
+
+    The decode benchmark's O(1)-in-prefix assertion rests on this: a
+    KV-cached decode step's dot FLOPs are a constant while the
+    recompute-the-prefix program's grow linearly with T.  Static counting
+    (like :func:`collective_stats`) — no execution, backend-independent
+    when fed ``jit(...).lower(...).as_text()``.  Dot-like ops the counter
+    cannot parse contribute zero here; :func:`dot_flops_report` surfaces
+    them as ``uncounted_ops``.
+    """
+    return dot_flops_report(program_text)["flops"]
+
+
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9,\s]*)\}:\s*\(\s*([0-9]+)\s*,\s*\{[0-9,\s]*\}")
+
+
+def input_output_aliases(compiled_text):
+    """Donation aliases of a compiled HLO module.
+
+    Parses the module header's ``input_output_alias={ {out}: (param,
+    {index}, kind), ... }`` block into a list of ``(output_index_path,
+    parameter_number)`` tuples.  An empty list means XLA aliased nothing —
+    for a program traced with ``donate_argnums`` that is a dropped
+    donation (the donation-auditor pass's error condition).
+    """
+    # the block lives on the HloModule header line (nested braces, so a
+    # balanced scan, not a regex); only that line is consulted so a string
+    # constant elsewhere cannot fake a header
+    for line in compiled_text.splitlines():
+        if "HloModule" not in line:
+            continue
+        key = "input_output_alias={"
+        at = line.find(key)
+        if at < 0:
+            return []
+        depth, start = 1, at + len(key)
+        end = start
+        for i in range(start, len(line)):
+            if line[i] == "{":
+                depth += 1
+            elif line[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        entries = []
+        for out_idx, param in _ALIAS_ENTRY_RE.findall(line[start:end]):
+            path = tuple(int(d) for d in out_idx.split(",") if d.strip())
+            entries.append((path, int(param)))
+        return entries
+    return []
+
+
+def collective_stats(hlo_text):
+    """Count collectives and sum their result payloads.
+
+    Async start/done pairs count once (the -start carries the shape).
+    Returns {op_name: {"count": int, "bytes": int}} plus two aggregate
+    entries: "total" over every op, and "overlappable" — the count/bytes
+    of collectives the backend emitted as async ``-start``/``-done``
+    pairs, i.e. communication the scheduler can overlap with compute
+    between the pair (the double-buffered ring's collective-permutes on
+    TPU land here; backends that keep sync collectives report 0).
+    """
+    stats = {}
+    overlappable = {"count": 0, "bytes": 0}
+    matches = []
+    for line in hlo_text.splitlines():
+        em = _INSTR_RE.search(line)
+        if em is None:
+            continue
+        shape_s, end = _scan_shape(line, em.end())
+        om = _OP_RE.match(line, end)
+        if om is None:
+            continue
+        matches.append((shape_s, om.group(1), om.group(2)))
+    for shape_s, op, suffix in matches:
+        if suffix == "-done":
+            continue
+        if suffix == "-start":
+            nbytes = _start_bytes(op, shape_s)
+            overlappable["count"] += 1
+            overlappable["bytes"] += nbytes
+        else:
+            nbytes = shape_bytes(shape_s)
+        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += nbytes
+    total = {"count": sum(e["count"] for e in stats.values()),
+             "bytes": sum(e["bytes"] for e in stats.values())}
+    stats["total"] = total
+    stats["overlappable"] = overlappable
+    return stats

@@ -1,0 +1,310 @@
+"""The five shipped analysis passes.
+
+Each pass statically audits one performance invariant the framework's PRs
+established, so a sharding-rule edit or a jit cache-key drift fails CI on
+the 8-virtual-device CPU mesh instead of silently regressing a headline:
+
+* :class:`DonationPass` — every donated buffer must survive to compiled
+  ``input_output_alias`` (dropped donation = steady-state allocation).
+* :class:`CollectiveBudgetPass` — collective counts/bytes per program
+  stay within the committed ``benchmarks/budgets.json`` ceilings (a
+  GSPMD-inserted all-gather from a sharding-spec regression trips it).
+* :class:`RetracePass` — each canonical program traces exactly once per
+  shape (weak-type/dtype drift = recompiles mid-loop).
+* :class:`HostSyncPass` — no host-callback primitives inside device
+  programs (the static half; ``fit()``'s ``MXNET_TRANSFER_GUARD`` runtime
+  guard is the dynamic half).
+* :class:`FlopDtypePass` — ``dot_flops`` coverage (uncounted dot-like ops
+  are an error, not a silent zero) and f32 dots inside bf16 programs.
+"""
+from __future__ import annotations
+
+from .framework import Pass
+from .hlo_parse import (collective_stats, dot_flops_report,
+                        input_output_aliases, shape_bytes_report)
+
+__all__ = ["DonationPass", "CollectiveBudgetPass", "RetracePass",
+           "HostSyncPass", "FlopDtypePass"]
+
+
+class DonationPass(Pass):
+    """Donated buffers must appear in compiled ``input_output_alias``.
+
+    The fused train step, eval step and decode step donate params / slots /
+    caches so XLA updates them in place; a dtype or shape drift between a
+    donated input and its updated output silently drops the alias and the
+    steady-state step starts allocating (and copying) every call.  The
+    artifact records how many buffers were donated at trace time; the
+    compiled module header records how many XLA actually aliased.
+    """
+
+    name = "donation"
+    requires = ("compiled",)
+
+    def run(self, artifact, context):
+        if not artifact.donated_leaves:
+            return [self.finding(
+                artifact, "info", "program donates nothing; pass skipped",
+                code="no-donation")]
+        aliases = input_output_aliases(artifact.compiled_text)
+        aliased_params = {param for _, param in aliases}
+        n = len(aliased_params)
+        if n >= artifact.donated_leaves:
+            return [self.finding(
+                artifact, "info",
+                "%d/%d donated buffers aliased" % (n, artifact.donated_leaves),
+                code="aliased", aliased=n,
+                donated=artifact.donated_leaves)]
+        return [self.finding(
+            artifact, "error",
+            "dropped donation: %d buffers donated but only %d aliased in "
+            "compiled input_output_alias — the step allocates fresh "
+            "buffers (and copies) every call" % (artifact.donated_leaves, n),
+            code="dropped-donation", aliased=n,
+            donated=artifact.donated_leaves,
+            alias_entries=[[list(path), param]
+                           for path, param in aliases])]
+
+
+class CollectiveBudgetPass(Pass):
+    """Collective counts/bytes vs the committed budget ceilings.
+
+    Budget layout (``benchmarks/budgets.json``)::
+
+        {"programs": {"<program>": {"collectives": {
+            "total": {"count": N, "bytes": B},
+            "all-gather": {"count": N, "bytes": B}, ...}}},
+         "suppressions": ["pass[:program[:code]]", ...]}
+
+    Every ceiling is inclusive (measured == budget passes).  Collective
+    ops present in the program but absent from its budget are errors —
+    a GSPMD regression typically shows up as a brand-new all-gather, not
+    as growth of an existing entry.  Byte ceilings more than 2x the
+    measurement emit an info row suggesting the budget be re-tightened
+    (``tools/mxlint.py --update-budgets``).
+    """
+
+    name = "collective-budget"
+    requires = ("compiled",)
+
+    def run(self, artifact, context):
+        budget = context.budget_for(artifact.name) or {}
+        ceilings = budget.get("collectives")
+        stats = collective_stats(artifact.compiled_text)
+        if ceilings is None:
+            sev = "info" if stats["total"]["count"] == 0 else "warning"
+            return [self.finding(
+                artifact, sev,
+                "no committed collective budget for this program "
+                "(measured: %d collectives, %d bytes) — run "
+                "tools/mxlint.py --update-budgets" %
+                (stats["total"]["count"], stats["total"]["bytes"]),
+                code="no-budget", measured=stats)]
+        findings = []
+        for op, measured in stats.items():
+            if op == "overlappable":
+                continue
+            ceiling = ceilings.get(op)
+            if ceiling is None:
+                if op != "total" and measured["count"] > 0:
+                    findings.append(self.finding(
+                        artifact, "error",
+                        "unbudgeted collective %r: %d op(s), %d bytes — a "
+                        "sharding-spec regression inserted a collective "
+                        "this program never had" %
+                        (op, measured["count"], measured["bytes"]),
+                        code="unbudgeted-op", op=op, measured=measured))
+                continue
+            for key in ("count", "bytes"):
+                if key in ceiling and measured[key] > ceiling[key]:
+                    findings.append(self.finding(
+                        artifact, "error",
+                        "collective %s %s over budget: %d > %d" %
+                        (op, key, measured[key], ceiling[key]),
+                        code="over-budget", op=op, kind=key,
+                        measured=measured[key], budget=ceiling[key]))
+            if "bytes" in ceiling and ceiling["bytes"] > 0 and \
+                    ceiling["bytes"] > 2 * max(measured["bytes"], 1):
+                findings.append(self.finding(
+                    artifact, "info",
+                    "collective %s byte budget %d is >2x the measured %d; "
+                    "consider --update-budgets" %
+                    (op, ceiling["bytes"], measured["bytes"]),
+                    code="slack-budget", op=op))
+        # ops budgeted but absent from the program: the ceiling is stale
+        # headroom a future regression could silently refill — surface it
+        for op, ceiling in ceilings.items():
+            if op in stats or ceiling.get("count", 0) == 0:
+                continue
+            findings.append(self.finding(
+                artifact, "info",
+                "budgeted collective %r no longer appears in the program "
+                "(%d op(s) / %d bytes of stale headroom); tighten with "
+                "--update-budgets" %
+                (op, ceiling.get("count", 0), ceiling.get("bytes", 0)),
+                code="stale-budget", op=op, budget=ceiling))
+        if not findings:
+            findings.append(self.finding(
+                artifact, "info",
+                "within budget: %d collectives, %d bytes" %
+                (stats["total"]["count"], stats["total"]["bytes"]),
+                code="within-budget", measured=stats["total"]))
+        return findings
+
+
+class RetracePass(Pass):
+    """Each canonical program traces exactly once per distinct shape.
+
+    The artifact's ``trace_count`` comes from the step programs' built-in
+    python-level trace counters (``CompiledTrainStep.trace_count``,
+    ``DecodePredictor.trace_counts``) or a
+    :class:`~mxnet_tpu.analysis.retrace.RetraceAuditor`; the builder
+    drives every program at least twice at identical shapes before
+    snapshotting, so a count above ``expected_traces`` is a cache miss at
+    "the same" signature — dtype/weak-type drift.  The auditor's recorded
+    signature diffs (``meta['retrace']``) say which leaf moved.
+    """
+
+    name = "retrace"
+    requires = ()
+
+    def run(self, artifact, context):
+        if artifact.trace_count is None:
+            return [self.finding(
+                artifact, "info", "no retrace instrumentation on this "
+                "artifact", code="no-instrumentation")]
+        record = artifact.meta.get("retrace") or {}
+        if artifact.trace_count <= artifact.expected_traces:
+            return [self.finding(
+                artifact, "info",
+                "traced %d time(s), %d expected" %
+                (artifact.trace_count, artifact.expected_traces),
+                code="no-retrace")]
+        diffs = record.get("diffs") or []
+        diff_text = "; ".join("|".join(d) for d in diffs if d) \
+            or "no signature diff recorded"
+        return [self.finding(
+            artifact, "error",
+            "retraced: %d traces for %d expected shape variant(s) — the "
+            "jit cache key drifted (%s)" %
+            (artifact.trace_count, artifact.expected_traces, diff_text),
+            code="retrace", traces=artifact.trace_count,
+            expected=artifact.expected_traces, record=record)]
+
+
+# jaxpr primitives that round-trip through the host; any of them inside a
+# hot-path program serializes the device on every step
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+# compiled-HLO ops that move data to/from the host mid-program.  send/recv
+# are deliberately NOT listed: they also carry device-to-device channel
+# traffic (cross-partition collectives can legalize through them).
+_HLO_HOST_OPS = ("outfeed(", "infeed(")
+
+
+class HostSyncPass(Pass):
+    """No host round-trips inside device programs.
+
+    Static scan: jaxpr callback primitives (``pure_callback`` /
+    ``io_callback`` / ``debug_callback`` — a stray ``jax.debug.print``
+    left in an op implementation lands here) and compiled-HLO host
+    transfer ops.  The runtime half is ``MXNET_TRANSFER_GUARD``, which
+    arms ``jax.transfer_guard_device_to_host`` around ``fit()``'s hot
+    loop (docs/static_analysis.md).
+    """
+
+    name = "host-sync"
+    requires = ("jaxpr",)
+
+    def run(self, artifact, context):
+        findings = []
+        text = artifact.jaxpr_text
+        for prim in _CALLBACK_PRIMS:
+            n = text.count(prim)
+            if n:
+                findings.append(self.finding(
+                    artifact, "error",
+                    "%d %s primitive(s) in the jaxpr: the program "
+                    "round-trips through the host every step" % (n, prim),
+                    code=prim, count=n))
+        if artifact.compiled_text is not None:
+            for op in _HLO_HOST_OPS:
+                n = sum(line.count(op)
+                        for line in artifact.compiled_text.splitlines()
+                        if "=" in line)
+                if n:
+                    findings.append(self.finding(
+                        artifact, "error",
+                        "%d %r op(s) in compiled HLO: host transfer "
+                        "inside the program" % (n, op.rstrip("(")),
+                        code="hlo-" + op.rstrip("("), count=n))
+        if not findings:
+            findings.append(self.finding(
+                artifact, "info", "no host callbacks or host transfers",
+                code="clean"))
+        return findings
+
+
+class FlopDtypePass(Pass):
+    """FLOP-counter coverage + unintended f32 upcasts in bf16 programs.
+
+    Coverage: ``dot_flops`` underpins the O(1)-in-prefix decode assertion
+    and the bench MFU numbers; a program containing dot-like ops the
+    counter cannot parse (``uncounted_ops``) makes every one of those
+    numbers a silent undercount — an error here.  Unknown element types
+    in the program's shapes (the ``shape_bytes`` width table) are
+    reported the same way.
+
+    Dtype: in a program whose declared compute dtype is bfloat16/float16,
+    every dot whose result element type is f32 is flagged (warning) — the
+    classic symptom of a cast that re-promoted the MXU path.  Checked on
+    the *lowered StableHLO*, which reflects what was asked for; backend
+    legalization (XLA:CPU rewrites bf16 dots through f32) happens later
+    and is out of scope.
+    """
+
+    name = "flop-dtype"
+    requires = ("stablehlo",)
+
+    def run(self, artifact, context):
+        findings = []
+        report = dot_flops_report(artifact.stablehlo_text)
+        for rec in report["uncounted_ops"]:
+            findings.append(self.finding(
+                artifact, "error",
+                "%d %r op(s) not modeled by dot_flops: FLOP totals for "
+                "this program are undercounts" % (rec["count"], rec["op"]),
+                code="uncounted:" + rec["op"], **rec))
+        # unknown element types are scanned in the compiled HLO, whose
+        # 'dtype[dims]' shape syntax is what shape_bytes parses (StableHLO
+        # writes tensor<...> shapes)
+        unknown = []
+        if artifact.compiled_text is not None:
+            _, unknown = shape_bytes_report(artifact.compiled_text)
+        if unknown:
+            findings.append(self.finding(
+                artifact, "warning",
+                "element types %s missing from the shape_bytes width "
+                "table: byte accounting skips them" % (unknown,),
+                code="unknown-dtype", dtypes=unknown))
+        cd = (artifact.compute_dtype or "").lower()
+        if cd in ("bfloat16", "bf16", "float16", "f16"):
+            low = {"bfloat16": "bf16", "bf16": "bf16",
+                   "float16": "f16", "f16": "f16"}[cd]
+            bad = [d for d in report["dots"] if d["dtype"] == "f32"]
+            if bad:
+                findings.append(self.finding(
+                    artifact, "warning",
+                    "%d of %d dots compute in f32 inside a %s program — "
+                    "an upcast re-promoted the matmul path (first: %s)" %
+                    (len(bad), len(report["dots"]), low,
+                     bad[0]["line"][:160]),
+                    code="f32-dot", count=len(bad),
+                    total_dots=len(report["dots"]),
+                    lines=[d["line"][:160] for d in bad[:8]]))
+        if not findings:
+            findings.append(self.finding(
+                artifact, "info",
+                "%d dot(s), %d FLOPs, full coverage" %
+                (len(report["dots"]), report["flops"]),
+                code="covered", flops=report["flops"]))
+        return findings

@@ -1,0 +1,227 @@
+"""Builders for the canonical programs the lint audits.
+
+``tools/mxlint.py`` (and the tier-1 smoke) checks five programs — the
+compiled surfaces behind every headline number so far:
+
+* ``train_step``  — the fused forward+backward+optimizer program
+  (bfloat16 compute, donated params/slots/aux);
+* ``eval_step``   — the forward+device-metric-accumulate program
+  ``score()`` arms (donated accumulator state);
+* ``prefill``     — the KV-cache prefill program;
+* ``decode_step`` — the donated one-token decode program;
+* ``ring_tp_step`` — the attention-LM fused step on the composed
+  (data, seq, model) mesh: ring attention with head groups sharded on
+  'model' (needs >= 4 devices; the smoke forces the 8-virtual-device
+  CPU platform, same trick as tests/conftest.py).
+
+Every program is driven at least twice at identical shapes before its
+artifact is snapshotted, so the retrace pass checks a real "second call
+hit the jit cache" fact, not a vacuous first-trace count.  Dims are tiny:
+the point is the *program structure* (collectives, aliasing, callbacks,
+dot dtypes), which does not depend on size.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CANONICAL_PROGRAMS", "build_canonical_artifacts"]
+
+CANONICAL_PROGRAMS = ("train_step", "eval_step", "prefill", "decode_step",
+                      "ring_tp_step")
+
+# tiny-but-structured dims shared by every builder
+_MLP = dict(batch=8, features=32, hidden=32, classes=8)
+_LM = dict(vocab=32, seq_len=16, embed=16, heads=4, ffn=32, layers=1,
+           batch=2)
+
+
+def _mlp_module(compute_dtype="bfloat16"):
+    """A classifier Module with the fused train step armed (bfloat16
+    compute so the dtype lint audits a mixed-precision program)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.io import DataBatch
+
+    d = _MLP
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=d["hidden"], name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=d["classes"], name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype=compute_dtype)
+    mod.bind(data_shapes=[("data", (d["batch"], d["features"]))],
+             label_shapes=[("softmax_label", (d["batch"],))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (d["batch"], d["features"]))
+                 .astype(np.float32))
+    y = nd.array(rng.randint(0, d["classes"], (d["batch"],))
+                 .astype(np.float32))
+    return mod, DataBatch([x], [y])
+
+
+def _lm_symbol():
+    from mxnet_tpu.models import attention_lm
+
+    d = _LM
+    return attention_lm.get_symbol(
+        vocab_size=d["vocab"], seq_len=d["seq_len"],
+        num_layers=d["layers"], embed=d["embed"], heads=d["heads"],
+        ffn_hidden=d["ffn"])
+
+
+def _lm_mesh_module(mesh_cfg):
+    """The attention LM bound on a mesh — the ring×TP composition's
+    training program."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    import jax
+
+    d = _LM
+    contexts = [mx.cpu(i) for i in range(len(jax.devices()))]
+    mod = mx.mod.Module(_lm_symbol(), context=contexts,
+                        mesh_config=mesh_cfg)
+    data_desc = DataDesc("data", (d["batch"], d["seq_len"]), layout="NT")
+    label_desc = DataDesc("softmax_label", (d["batch"], d["seq_len"]),
+                          layout="NT")
+    mod.bind(data_shapes=[data_desc], label_shapes=[label_desc])
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, d["vocab"], size=(d["batch"], d["seq_len"])) \
+        .astype(np.float32)
+    y = np.concatenate([x[:, 1:], np.zeros((d["batch"], 1), np.float32)],
+                       axis=1)
+    batch = DataBatch([nd.array(x)], [nd.array(y)],
+                      provide_data=[data_desc],
+                      provide_label=[label_desc])
+    return mod, batch
+
+
+def _drive_fused(mod, batch, steps=2):
+    """Run the fused step twice at one shape (retrace ground truth)."""
+    for _ in range(steps):
+        mod.forward_backward(batch)
+        mod.update()
+    if mod._fused_step is None:
+        raise MXNetError("fused train step did not arm; cannot build its "
+                         "artifact (check MXNET_FUSED_TRAIN_STEP)")
+    return mod._fused_step
+
+
+def _eval_artifact(mod, batch):
+    from mxnet_tpu import metric as metric_mod
+    from mxnet_tpu.train_step import CompiledEvalStep
+
+    m = metric_mod.create("acc")
+    step = CompiledEvalStep(mod._exec_group, m)
+    try:
+        step.run(batch)
+        step.run(batch)
+        return step.artifact(name="eval_step")
+    finally:
+        step.finish()
+
+
+def _decode_artifacts():
+    from mxnet_tpu.decode import DecodePredictor
+
+    import jax
+
+    d = _LM
+    rng = np.random.RandomState(0)
+    sym = _lm_symbol()
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(d["batch"], d["seq_len"]),
+        softmax_label=(d["batch"], d["seq_len"]))
+    params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params[name] = rng.normal(0, 0.02, shape).astype(np.float32)
+    for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+        params["aux:" + name] = np.zeros(shape, np.float32)
+    pred = DecodePredictor(sym, params, cache_len=d["seq_len"],
+                           temperature=0.0)
+    prompt_len = d["seq_len"] // 2
+    prompts = rng.randint(0, d["vocab"],
+                          size=(d["batch"], d["seq_len"])) \
+        .astype(np.float32)
+    prompts[:, prompt_len:] = 0.0
+    key = jax.random.PRNGKey(0)
+    state, _ = pred.prefill(prompts, prompt_len, key)
+    state, _ = pred.prefill(prompts, prompt_len, key)
+    state, _ = pred.step(state, key)
+    state, _ = pred.step(state, key)
+    return (pred.prefill_artifact(d["batch"], d["seq_len"]),
+            pred.decode_artifact(state))
+
+
+def _ring_mesh_config(n_dev):
+    from mxnet_tpu.parallel import MeshConfig
+
+    if n_dev >= 8:
+        return MeshConfig(data=2, seq=2, model=2)
+    if n_dev >= 4:
+        return MeshConfig(data=1, seq=2, model=2)
+    return None
+
+
+def build_canonical_artifacts(names=None):
+    """Build the requested canonical artifacts (default: all five).
+
+    Returns ``(artifacts, notes)`` — ``notes`` maps a program that could
+    not be built on this host (e.g. ``ring_tp_step`` without >= 4
+    devices) to the reason, so the caller can surface the gap instead of
+    silently auditing a smaller set.
+    """
+    import jax
+
+    want = list(names) if names else list(CANONICAL_PROGRAMS)
+    unknown = [n for n in want if n not in CANONICAL_PROGRAMS]
+    if unknown:
+        raise MXNetError("unknown canonical program(s) %s; known: %s"
+                         % (unknown, list(CANONICAL_PROGRAMS)))
+    artifacts, notes = [], {}
+
+    if "train_step" in want or "eval_step" in want:
+        mod, batch = _mlp_module()
+        if "train_step" in want:
+            # the eval program needs only the bound group; driving (and
+            # compiling) the fused step is the train artifact's cost
+            step = _drive_fused(mod, batch)
+            artifacts.append(step.artifact(name="train_step"))
+        if "eval_step" in want:
+            artifacts.append(_eval_artifact(mod, batch))
+
+    if "prefill" in want or "decode_step" in want:
+        prefill, decode = _decode_artifacts()
+        if "prefill" in want:
+            artifacts.append(prefill)
+        if "decode_step" in want:
+            artifacts.append(decode)
+
+    if "ring_tp_step" in want:
+        cfg = _ring_mesh_config(len(jax.devices()))
+        if cfg is None:
+            notes["ring_tp_step"] = (
+                "needs >= 4 devices for a (seq, model) mesh; %d present "
+                "— run under the 8-virtual-device CPU platform "
+                "(tools/mxlint.py --smoke does this)" % len(jax.devices()))
+        else:
+            mod, batch = _lm_mesh_module(cfg)
+            step = _drive_fused(mod, batch)
+            artifacts.append(step.artifact(name="ring_tp_step"))
+
+    order = {n: i for i, n in enumerate(CANONICAL_PROGRAMS)}
+    artifacts.sort(key=lambda a: order.get(a.name, len(order)))
+    return artifacts, notes

@@ -1,0 +1,115 @@
+"""Retrace auditing — jit cache misses become a checked invariant.
+
+A canonical program must trace exactly once per distinct input shape: a
+second trace at the "same" shapes means the cache key drifted — a weak
+type flipped, a dtype changed (x64 promotion, a float where an int
+belonged), a python-hashable static changed identity.  Each retrace
+recompiles the whole program mid-loop, which on a TPU rig turns a
+microseconds step into seconds, silently.
+
+:class:`RetraceAuditor` wraps a python callable BEFORE jitting: the
+wrapper counts trace events (the python body runs only while tracing) and
+records the abstract signature of every call, so after driving the
+program the auditor can say not just *that* it retraced but *what
+differed* between the colliding signatures.  The shipped step programs
+(``CompiledTrainStep``, ``CompiledEvalStep``, ``DecodePredictor``) carry
+the same counters built in; this class is the standalone tool for
+auditing arbitrary jitted functions and the machinery behind the
+dtype-drift tests.
+"""
+from __future__ import annotations
+
+__all__ = ["RetraceAuditor", "arg_signature", "signature_diff"]
+
+
+def arg_signature(args, kwargs=None):
+    """Flatten a call's arguments into a hashable abstract signature:
+    one ``(shape, dtype, weak_type)`` triple per array leaf."""
+    import jax
+    import jax.tree_util as jtu
+
+    leaves = jtu.tree_leaves((args, kwargs or {}))
+    sig = []
+    for leaf in leaves:
+        try:
+            aval = jax.api_util.shaped_abstractify(leaf)
+            sig.append((tuple(aval.shape), str(aval.dtype),
+                        bool(getattr(aval, "weak_type", False))))
+        except (TypeError, ValueError):
+            # non-array static (python scalar in a static arg, string...)
+            sig.append(("static", repr(leaf), False))
+    return tuple(sig)
+
+
+def signature_diff(a, b):
+    """Human-readable leaf-wise differences between two signatures."""
+    diffs = []
+    if len(a) != len(b):
+        diffs.append("leaf count %d != %d" % (len(a), len(b)))
+    for i, (la, lb) in enumerate(zip(a, b)):
+        if la == lb:
+            continue
+        parts = []
+        for name, va, vb in zip(("shape", "dtype", "weak_type"), la, lb):
+            if va != vb:
+                parts.append("%s %s -> %s" % (name, va, vb))
+        diffs.append("leaf %d: %s" % (i, "; ".join(parts)))
+    return diffs
+
+
+class RetraceAuditor:
+    """Wrap a callable so its jit traces and call signatures are recorded.
+
+    Usage::
+
+        auditor = RetraceAuditor(step_impl)
+        fn = jax.jit(auditor.wrapped, donate_argnums=(0,))
+        fn(state, x); fn(state, x2)          # drive the program
+        rec = auditor.record()
+        assert rec["traces"] == len(rec["unique_signatures"])
+
+    ``traces`` counts how many times the python body actually re-traced;
+    ``signatures`` records one abstract signature per *call*.  More traces
+    than unique signatures cannot happen (jax caches on the signature);
+    more *unique signatures* than the program's expected shape variants is
+    the drift the retrace pass reports, and ``diffs`` pinpoints which
+    leaf's dtype/weak-type/shape moved between consecutive new signatures.
+    """
+
+    def __init__(self, fn, name=None):
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "fn")
+        self.traces = 0
+        self.calls = 0
+        self.signatures = []
+
+        def wrapped(*args, **kwargs):
+            self.traces += 1
+            return fn(*args, **kwargs)
+
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        self.wrapped = wrapped
+
+    def observe(self, *args, **kwargs):
+        """Record one call's signature (invoke right before the jitted
+        call with the same arguments)."""
+        self.calls += 1
+        self.signatures.append(arg_signature(args, kwargs))
+
+    def record(self, expected_traces=1):
+        """Summary dict for ``ProgramArtifact.meta['retrace']``."""
+        unique = []
+        for sig in self.signatures:
+            if sig not in unique:
+                unique.append(sig)
+        diffs = []
+        for prev, cur in zip(unique, unique[1:]):
+            diffs.append(signature_diff(prev, cur))
+        return {
+            "name": self.name,
+            "traces": self.traces,
+            "calls": self.calls,
+            "unique_signatures": len(unique),
+            "expected_traces": expected_traces,
+            "diffs": diffs,
+        }

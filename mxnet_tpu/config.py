@@ -187,6 +187,25 @@ register("MXNET_DECODE_MAX_NEW", int, 256,
          "Default cap on generated tokens per request in the serving loop "
          "when the caller gives no explicit max_new_tokens (a sequence "
          "with no EOS must retire eventually so its slot can refill).")
+register("MXNET_TRANSFER_GUARD", str, "off",
+         "Arm jax.transfer_guard_device_to_host around fit()'s hot loop: "
+         "'log' reports and 'disallow' raises on a device->host transfer "
+         "inside the training epoch, making the async loop's zero-per-"
+         "step-host-syncs invariant a runtime-checked guarantee on real "
+         "accelerators (same-device CPU 'transfers' are free and never "
+         "trip it; the static half is analysis.HostSyncPass).  'off' "
+         "(default) leaves the loop unguarded — required for the classic "
+         "host-metric path, which reads outputs every step.")
+register("MXNET_ANALYSIS_SUPPRESS", str, "",
+         "Comma-separated suppression patterns for static-analysis "
+         "findings: 'pass-name[:program[:code]]' with '*' wildcards "
+         "(e.g. 'flop-dtype:decode_step:f32-dot').  Applied on top of "
+         "the budget file's suppressions list; suppressed findings stay "
+         "in reports, marked, so waivers are visible.")
+register("MXNET_ANALYSIS_BUDGETS", str, "",
+         "Path to the static-analysis budget file consumed by "
+         "analysis.load_budgets / tools/mxlint.py.  Empty (default) = "
+         "the committed benchmarks/budgets.json.")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

@@ -166,6 +166,14 @@ class DecodePredictor:
         from . import config as _config
 
         donate = (1,) if _config.get("MXNET_DECODE_DONATE") else ()
+        self._donate = bool(donate)
+        # retrace instrumentation (analysis.RetracePass): the impl bodies
+        # run only while jax traces them, so these counters check the
+        # serving loop's "zero retraces" claim — decode must trace ONCE,
+        # prefill once per admitted (B, P) shape.  Probes (lowering for
+        # artifact/FLOP text) set _probing and don't count.
+        self.trace_counts = {"prefill": 0, "decode": 0}
+        self._probing = False
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=donate)
         self._prefill_fns = {}   # (B, P) -> jitted prefill program
         # jnp dummies reused every call (sample_tokens at temperature 0
@@ -298,6 +306,8 @@ class DecodePredictor:
     def _prefill_impl(self, env, tokens, lens, key):
         import jax.numpy as jnp
 
+        if not self._probing:
+            self.trace_counts["prefill"] += 1
         probs3, caches = self._run(env, tokens, None, 0)
         # output at the last REAL prompt position, per sequence
         last = jnp.clip(lens - 1, 0, tokens.shape[1] - 1)
@@ -307,6 +317,8 @@ class DecodePredictor:
         return DecodeState(caches, lens, tok), probs
 
     def _decode_impl(self, env, state, key):
+        if not self._probing:
+            self.trace_counts["decode"] += 1
         probs3, caches = self._run(env, state.tok, state.caches, state.lens)
         probs = probs3[:, 0]
         tok = self._sample(key, probs)
@@ -395,24 +407,86 @@ class DecodePredictor:
         """Lowered (pre-optimization) StableHLO of the decode-step program
         at this state's shapes — feed to ``parallel.hlo_stats.dot_flops``
         for the O(1)-in-prefix FLOP assertion (bench_decode.py)."""
-        return self._decode_fn.lower(
-            self._env, state,
-            key if key is not None else self._zero_key).as_text()
+        self._probing = True
+        try:
+            return self._decode_fn.lower(
+                self._env, state,
+                key if key is not None else self._zero_key).as_text()
+        finally:
+            self._probing = False
 
-    def prefill_text(self, b, p):
-        """Lowered StableHLO of the (b, p) prefill program — the
-        recompute-the-prefix cost baseline for the FLOP assertion."""
+    def _prefill_args(self, b, p):
         import jax
         import jax.numpy as jnp
 
-        fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
         env = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for n, v in self._env.items()}
         tokens = jax.ShapeDtypeStruct((b, p), jnp.float32)
         lens = jax.ShapeDtypeStruct((b,), jnp.int32)
         key = jax.ShapeDtypeStruct(self._zero_key.shape,
                                    self._zero_key.dtype)
-        return fn.lower(env, tokens, lens, key).as_text()
+        return env, tokens, lens, key
+
+    def prefill_text(self, b, p):
+        """Lowered StableHLO of the (b, p) prefill program — the
+        recompute-the-prefix cost baseline for the FLOP assertion."""
+        import jax
+
+        fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
+        self._probing = True
+        try:
+            return fn.lower(*self._prefill_args(b, p)).as_text()
+        finally:
+            self._probing = False
+
+    def prefill_artifact(self, b, p, name="prefill"):
+        """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
+        (b, p) prefill program.  Prefill donates nothing (its caches are
+        born inside the program); expected traces = one per distinct
+        admitted (B, P) shape."""
+        import jax
+
+        from .analysis.artifact import artifact_from_jit
+
+        fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
+        count = self.trace_counts["prefill"]
+        expected = max(len(self._prefill_fns), 1)
+        self._probing = True
+        try:
+            return artifact_from_jit(
+                fn, self._prefill_args(b, p), name=name, donated_leaves=0,
+                mesh_shape=dict(self._mesh.shape)
+                if self._mesh is not None else None,
+                trace_count=count, expected_traces=expected,
+                cache_len=self._cache_len)
+        finally:
+            self._probing = False
+
+    def decode_artifact(self, state, key=None, name="decode_step"):
+        """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
+        donated decode-step program at this state's shapes — the "zero
+        retraces / zero allocation per token" serving claims as checkable
+        metadata (donated leaves = every cache/len/token buffer)."""
+        import jax.tree_util as jtu
+
+        from .analysis.artifact import artifact_from_jit, aval_of as _aval
+
+        env = {n: _aval(v) for n, v in self._env.items()}
+        astate = jtu.tree_map(_aval, state)
+        akey = _aval(key if key is not None else self._zero_key)
+        donated = len(jtu.tree_leaves(astate)) if self._donate else 0
+        count = self.trace_counts["decode"]
+        self._probing = True
+        try:
+            return artifact_from_jit(
+                self._decode_fn, (env, astate, akey), name=name,
+                donated_leaves=donated,
+                mesh_shape=dict(self._mesh.shape)
+                if self._mesh is not None else None,
+                trace_count=count, expected_traces=1,
+                cache_len=self._cache_len)
+        finally:
+            self._probing = False
 
 
 class DecodeServer:

@@ -204,6 +204,8 @@ class BaseModule:
         Input-pipeline stalls and host waits are recorded in
         ``profiler.step_stats`` for the bench contract.
         """
+        from contextlib import ExitStack
+
         from .. import config as _config
         from .. import profiler as _prof
 
@@ -213,33 +215,47 @@ class BaseModule:
         fences = deque()
         nbatch = 0
         it = iter(train_data)
-        while True:
-            t0 = time.perf_counter()
-            try:
-                batch = next(it)
-            except StopIteration:
-                break
-            _prof.record_input_wait(time.perf_counter() - t0)
-            if monitor is not None:
-                monitor.tic()
-            self.forward_backward(batch)
-            self.update()
-            self.update_metric(eval_metric, batch.label)
-            fence = self._dispatch_fence()
-            if fence is not None:
-                fences.append(fence)
-                # at most `limit` dispatched-but-unfinished steps: with
-                # limit=1 this waits on the step just issued (synchronous)
-                if len(fences) >= limit:
-                    t0 = time.perf_counter()
-                    _block_on(fences.popleft())
-                    _prof.record_host_wait(time.perf_counter() - t0)
-            if monitor is not None:
-                monitor.toc_print()
-            _prof.record_step()
-            _fire(batch_end_callback,
-                  BatchEndParam(epoch, nbatch, eval_metric, locals()))
-            nbatch += 1
+        # MXNET_TRANSFER_GUARD arms jax's device->host transfer guard for
+        # the whole epoch body: with device-side metrics + prefetch + the
+        # fence deque, the hot loop performs no d2h at all, and 'disallow'
+        # turns that invariant into a runtime error on the TPU rig (the
+        # analysis host-sync pass is the static half).  Thread-local, so
+        # the prefetch worker's h2d device_puts are unaffected.
+        guard = str(_config.get("MXNET_TRANSFER_GUARD") or "off").lower()
+        stack = ExitStack()
+        if guard not in ("", "off"):
+            import jax
+
+            stack.enter_context(jax.transfer_guard_device_to_host(guard))
+        with stack:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                _prof.record_input_wait(time.perf_counter() - t0)
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                fence = self._dispatch_fence()
+                if fence is not None:
+                    fences.append(fence)
+                    # at most `limit` dispatched-but-unfinished steps: with
+                    # limit=1 this waits on the step just issued
+                    # (synchronous)
+                    if len(fences) >= limit:
+                        t0 = time.perf_counter()
+                        _block_on(fences.popleft())
+                        _prof.record_host_wait(time.perf_counter() - t0)
+                if monitor is not None:
+                    monitor.toc_print()
+                _prof.record_step()
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch, nbatch, eval_metric, locals()))
+                nbatch += 1
         if fences:
             # steps chain through donated params, so the newest fence
             # transitively covers every outstanding step

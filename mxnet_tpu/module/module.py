@@ -613,6 +613,27 @@ class Module(BaseModule):
         self._eval_step_cache = (self._exec_group.exec_, eval_metric, step)
         return step
 
+    def program_artifacts(self):
+        """The module's compiled programs as analysis artifacts.
+
+        Returns ``{name: ProgramArtifact}`` for every program this module
+        currently holds compiled: the fused train step (after its first
+        run) and the cached compiled eval step (after a device-metric
+        ``score``).  The uniform probe surface ``tools/mxlint.py`` and
+        custom audits consume — see docs/static_analysis.md.
+        """
+        arts = {}
+        if self._fused_step is not None:
+            art = self._fused_step.artifact(group=self._exec_group)
+            if art is not None:
+                arts[art.name] = art
+        cached = getattr(self, "_eval_step_cache", None)
+        if cached is not None:
+            art = cached[2].artifact()
+            if art is not None:
+                arts[art.name] = art
+        return arts
+
     def _wrap_train_data(self, train_data):
         from .. import config as _config
         from ..io import DevicePrefetchIter

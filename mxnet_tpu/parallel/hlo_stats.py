@@ -1,224 +1,18 @@
 """Collective-communication accounting from compiled HLO text.
 
-The reference measures distributed communication empirically
-(``tools/bandwidth/measure.py``); under XLA the collectives are explicit in
-the optimized HLO, so the framework can *statically* count them and total
-their payload bytes.  Used by tests/test_tensor_parallel.py (asserting the
-Megatron plan emits fewer collectives than naive sharding) and
-tools/bandwidth.py (comm volume per training step).
+Absorbed into the static-analysis package as its parsing layer
+(``mxnet_tpu/analysis/hlo_parse.py``): the counting here grew from a
+bandwidth probe into the substrate of the pass framework's budget /
+FLOP / donation audits, so the implementation now lives beside the
+passes that consume it.  This module remains the stable import path for
+the test-suite tripwires and the benches (``collective_stats``,
+``shape_bytes``, ``dot_flops`` — plus the newer report forms).
 """
 from __future__ import annotations
 
-import re
+from ..analysis.hlo_parse import (collective_stats, dot_flops,
+                                  dot_flops_report, input_output_aliases,
+                                  shape_bytes, shape_bytes_report)
 
-__all__ = ["collective_stats", "shape_bytes", "dot_flops"]
-
-_DTYPE_BYTES = {
-    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
-    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
-    "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16,
-}
-
-_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
-
-# an instruction line: '%name = SHAPE op(...)'.  SHAPE is extracted with a
-# balanced-paren scan, not a depth-limited regex: tuple shapes nest (grouped
-# async collectives carry tuples of buffers) and TPU layout annotations like
-# {1,0:T(8,128)} add parens at arbitrary depth inside them.
-_INSTR_RE = re.compile(r"=\s*")
-_OP_RE = re.compile(
-    r"\s*(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
-    r"(-start|-done)?\(")
-
-
-def _scan_shape(line, start):
-    """Return (shape_str, end_index) for the shape beginning at `start` —
-    either a balanced parenthesized tuple or a single whitespace-free
-    token."""
-    if start < len(line) and line[start] == "(":
-        depth = 0
-        for i in range(start, len(line)):
-            if line[i] == "(":
-                depth += 1
-            elif line[i] == ")":
-                depth -= 1
-                if depth == 0:
-                    return line[start:i + 1], i + 1
-        return line[start:], len(line)
-    m = re.match(r"\S+", line[start:])
-    if m is None:
-        return "", start
-    return m.group(0), start + m.end()
-
-
-def shape_bytes(shape_str):
-    """Total bytes of every 'dtype[dims]' shape in the string (tuples ok)."""
-    total = 0
-    for dtype, dims in _SHAPE_RE.findall(shape_str):
-        width = _DTYPE_BYTES.get(dtype)
-        if width is None:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * width
-    return total
-
-
-def _split_top_level(tuple_str):
-    """Split '(a, (b, c), d)' into top-level elements ['a', '(b, c)', 'd']."""
-    s = tuple_str.strip()
-    if not (s.startswith("(") and s.endswith(")")):
-        return [s]
-    s = s[1:-1]
-    parts, depth, start = [], 0, 0
-    for i, ch in enumerate(s):
-        if ch in "({[":
-            depth += 1
-        elif ch in ")}]":
-            depth -= 1
-        elif ch == "," and depth == 0:
-            parts.append(s[start:i])
-            start = i + 1
-    parts.append(s[start:])
-    return [p.strip() for p in parts if p.strip()]
-
-
-def _start_bytes(op, shape_s):
-    """Result payload of an async '-start' tuple shape.
-
-    The tuple layout is op-specific (verified against compiled HLO):
-    ``all-reduce-start`` has the SAME shape as the sync op — a flat tuple
-    of results when XLA combined several all-reduces — so every buffer
-    counts.  ``all-gather-start`` / ``reduce-scatter-start`` /
-    ``collective-permute-start`` carry
-    ``(operand(s), result(s), [u32 context scalars...])`` — count only
-    the result element (itself possibly a tuple for grouped ops).
-    Summing naively would double those (reduce-scatter-start used to fall
-    into the generic fallback and did exactly that, inflating absolute
-    KiB/step); taking the single largest buffer (the old rule)
-    undercounts any grouped form.
-    """
-    parts = _split_top_level(shape_s)
-    parts = [p for p in parts
-             if not re.fullmatch(r"[su]32\[\]\S*", p)]  # context scalars
-    if not parts:
-        return 0
-    if op == "all-reduce":
-        return sum(shape_bytes(p) for p in parts)
-    if op in ("all-gather", "reduce-scatter", "collective-permute") \
-            and len(parts) >= 2:
-        return shape_bytes(parts[1])
-    # generic async wrapper: ((operands...), results, ctx) — a leading
-    # tuple element marks the operand pack; otherwise flat results
-    if len(parts) >= 2 and parts[0].startswith("("):
-        return shape_bytes(parts[1])
-    return sum(shape_bytes(p) for p in parts)
-
-
-# stablehlo: '%3 = stablehlo.dot_general %1, %2, batching_dims = [0] x [0],
-#   contracting_dims = [1] x [0] ... : (tensor<8x128xf32>, ...) -> tensor<...>'
-_SH_DOT_RE = re.compile(
-    r"dot_general\b.*?contracting_dims\s*=\s*\[([0-9,\s]*)\]\s*x\s*\[[0-9,\s]*\]"
-    r".*?:\s*\(tensor<([^>]+)>.*?->\s*tensor<([^>]+)>")
-# HLO: '%dot.3 = f32[8,512]{1,0} dot(f32[8,128]{1,0} %a, ...),
-#   lhs_contracting_dims={1}, rhs_contracting_dims={0}'
-_HLO_DOT_RE = re.compile(
-    r"=\s*([a-z][a-z0-9]+\[[0-9,]*\])\S*\s+dot\(\s*([a-z][a-z0-9]+\[[0-9,]*\])"
-    r".*?lhs_contracting_dims=\{([0-9,]*)\}")
-
-
-def _tensor_dims(spec):
-    """'2x4x64xf32' -> [2, 4, 64] (scalar 'f32' -> [])."""
-    return [int(d) for d in spec.split("x")[:-1]]
-
-
-def _bracket_dims(spec):
-    """'f32[8,128]' -> [8, 128]."""
-    inner = spec[spec.index("[") + 1:spec.index("]")]
-    return [int(d) for d in inner.split(",") if d]
-
-
-def dot_flops(program_text):
-    """Total matmul FLOPs (2 * result elements * contraction size) of every
-    dot in a lowered program — StableHLO ``dot_general`` and HLO ``dot(``
-    lines both count, fusion bodies included.
-
-    The decode benchmark's O(1)-in-prefix assertion rests on this: a
-    KV-cached decode step's dot FLOPs are a constant while the
-    recompute-the-prefix program's grow linearly with T.  Static counting
-    (like :func:`collective_stats`) — no execution, backend-independent
-    when fed ``jit(...).lower(...).as_text()``.
-    """
-    total = 0
-    for line in program_text.splitlines():
-        m = _SH_DOT_RE.search(line)
-        if m is not None:
-            cdims = [int(d) for d in m.group(1).replace(" ", "").split(",")
-                     if d]
-            lhs = _tensor_dims(m.group(2))
-            out = _tensor_dims(m.group(3))
-            contract = 1
-            for d in cdims:
-                contract *= lhs[d]
-            n = 1
-            for d in out:
-                n *= d
-            total += 2 * n * contract
-            continue
-        m = _HLO_DOT_RE.search(line)
-        if m is not None:
-            out = _bracket_dims(m.group(1))
-            lhs = _bracket_dims(m.group(2))
-            cdims = [int(d) for d in m.group(3).split(",") if d]
-            contract = 1
-            for d in cdims:
-                contract *= lhs[d]
-            n = 1
-            for d in out:
-                n *= d
-            total += 2 * n * contract
-    return total
-
-
-def collective_stats(hlo_text):
-    """Count collectives and sum their result payloads.
-
-    Async start/done pairs count once (the -start carries the shape).
-    Returns {op_name: {"count": int, "bytes": int}} plus two aggregate
-    entries: "total" over every op, and "overlappable" — the count/bytes
-    of collectives the backend emitted as async ``-start``/``-done``
-    pairs, i.e. communication the scheduler can overlap with compute
-    between the pair (the double-buffered ring's collective-permutes on
-    TPU land here; backends that keep sync collectives report 0).
-    """
-    stats = {}
-    overlappable = {"count": 0, "bytes": 0}
-    matches = []
-    for line in hlo_text.splitlines():
-        em = _INSTR_RE.search(line)
-        if em is None:
-            continue
-        shape_s, end = _scan_shape(line, em.end())
-        om = _OP_RE.match(line, end)
-        if om is None:
-            continue
-        matches.append((shape_s, om.group(1), om.group(2)))
-    for shape_s, op, suffix in matches:
-        if suffix == "-done":
-            continue
-        if suffix == "-start":
-            nbytes = _start_bytes(op, shape_s)
-            overlappable["count"] += 1
-            overlappable["bytes"] += nbytes
-        else:
-            nbytes = shape_bytes(shape_s)
-        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
-        entry["count"] += 1
-        entry["bytes"] += nbytes
-    total = {"count": sum(e["count"] for e in stats.values()),
-             "bytes": sum(e["bytes"] for e in stats.values())}
-    stats["total"] = total
-    stats["overlappable"] = overlappable
-    return stats
+__all__ = ["collective_stats", "shape_bytes", "shape_bytes_report",
+           "dot_flops", "dot_flops_report", "input_output_aliases"]

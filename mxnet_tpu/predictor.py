@@ -210,6 +210,20 @@ class Predictor:
 
         return fn, feed_names
 
+    def _pure_fn_specs(self):
+        """``(pure_fn, input avals)`` — the one builder behind every
+        export/analysis surface, so spec construction cannot diverge
+        between them."""
+        import jax
+
+        fn, feed_names = self._pure_fn()
+        specs = []
+        for n in feed_names:
+            dt = self._exec.arg_dict[n].data.dtype
+            specs.append(
+                jax.ShapeDtypeStruct(tuple(self._input_shapes[n]), dt))
+        return fn, specs
+
     def export(self, path=None):
         """Serialize the jitted forward as a StableHLO artifact
         (``jax.export`` bytes).  The analog of the reference's
@@ -218,12 +232,7 @@ class Predictor:
         import jax
         from jax import export as jax_export
 
-        fn, feed_names = self._pure_fn()
-        specs = []
-        for n in feed_names:
-            dt = self._exec.arg_dict[n].data.dtype
-            specs.append(
-                jax.ShapeDtypeStruct(tuple(self._input_shapes[n]), dt))
+        fn, specs = self._pure_fn_specs()
         exported = jax_export.export(jax.jit(fn))(*specs)
         blob = exported.serialize()
         if path is not None:
@@ -231,17 +240,26 @@ class Predictor:
                 f.write(blob)
         return blob
 
+    def artifact(self, name="predict_forward"):
+        """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
+        inference forward at the bound input shapes — the same uniform
+        jaxpr/StableHLO/compiled-HLO surface the training-step and decode
+        programs expose, so the analysis passes can audit a deployment
+        graph (host-callback lint, FLOP coverage) before it ships."""
+        import jax
+
+        from .analysis.artifact import artifact_from_jit
+
+        fn, specs = self._pure_fn_specs()
+        return artifact_from_jit(jax.jit(fn), specs, name=name,
+                                 donated_leaves=0)
+
     def export_stablehlo_text(self):
         """Human-readable StableHLO of the forward program."""
         import jax
         from jax import export as jax_export
 
-        fn, feed_names = self._pure_fn()
-        specs = []
-        for n in feed_names:
-            dt = self._exec.arg_dict[n].data.dtype
-            specs.append(
-                jax.ShapeDtypeStruct(tuple(self._input_shapes[n]), dt))
+        fn, specs = self._pure_fn_specs()
         exported = jax_export.export(jax.jit(fn))(*specs)
         return exported.mlir_module()
 

@@ -54,6 +54,14 @@ class CompiledEvalStep:
     def __init__(self, exec_group, metric):
         from .metric import DeviceMetricAccumulator
 
+        # retrace instrumentation (analysis.RetracePass): the python body
+        # below runs only while jax traces it, so this counter is the
+        # ground truth for "the eval program traced exactly once" (the
+        # eval_shape validation probe shares the jit trace cache, so it
+        # IS that one trace).  artifact() lowering sets _probing so probe
+        # re-traces don't count as cache misses.
+        self.trace_count = 0
+        self._probing = False
         exe = exec_group.exec_
         self._group = exec_group
         self._exec = exe
@@ -84,6 +92,8 @@ class CompiledEvalStep:
         param_names = self._param_names
 
         def step(params, aux, mstate, data, rng):
+            if not self._probing:
+                self.trace_count += 1
             env = dict(zip(param_names, params))
             env.update(data)
             arg_vals = [env[n] for n in exe._arg_names]
@@ -92,6 +102,8 @@ class CompiledEvalStep:
             return acc.update(mstate, labels, list(outs))
 
         self._fn = jax.jit(step, donate_argnums=(2,))
+        self._last_args = None   # aval snapshot for artifact probes
+        self._snap_traces = -1   # trace_count the snapshot was taken at
 
     def _place(self, arr, name):
         import jax
@@ -131,10 +143,31 @@ class CompiledEvalStep:
             import jax
 
             # trace-only probe: a metric mirror this graph rejects must
-            # fail BEFORE the donated accumulator state is consumed
+            # fail BEFORE the donated accumulator state is consumed.  It
+            # COUNTS as the program's one trace — eval_shape on a jitted
+            # fn populates the same trace cache the real call hits.
             jax.eval_shape(self._fn, params, aux, self._acc.state, data,
                            rng)
             self._validated = True
+        if self._last_args is None or self._snap_traces != self.trace_count:
+            # aval snapshot for artifact probes — (re)built only when no
+            # snapshot exists or the program re-traced, not per batch
+            import jax
+            import jax.tree_util as jtu
+
+            from .analysis.artifact import aval_of
+
+            def _bare(x):
+                # accumulator scalars stay sharding-free: they are
+                # re-seeded uncommitted after drains and relocate with
+                # the program
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+            self._last_args = (
+                jtu.tree_map(aval_of, params), jtu.tree_map(aval_of, aux),
+                jtu.tree_map(_bare, self._acc.state),
+                jtu.tree_map(aval_of, data), aval_of(rng))
+            self._snap_traces = self.trace_count
         self._acc.commit(self._fn(params, aux, self._acc.state, data, rng))
 
     def finish(self):
@@ -148,6 +181,30 @@ class CompiledEvalStep:
         instead of recompiling every epoch)."""
         self._acc.install()
         return self
+
+    def artifact(self, name="eval_step"):
+        """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
+        eval program at the last-run shapes (None before the first
+        ``run``).  Same probe economics as ``compiled_hlo``: avals only,
+        throwaway compile, trace flagged as non-counting."""
+        import jax.tree_util as jtu
+
+        from .analysis.artifact import artifact_from_jit
+
+        if self._last_args is None:
+            return None
+        params, aux, mstate, data, rng = self._last_args
+        donated = len(jtu.tree_leaves(mstate))
+        count = self.trace_count
+        self._probing = True
+        try:
+            return artifact_from_jit(
+                self._fn, (params, aux, mstate, data, rng), name=name,
+                donated_leaves=donated, trace_count=count,
+                expected_traces=1,
+                metric=type(self._acc.metric).__name__)
+        finally:
+            self._probing = False
 
 
 class CompiledTrainStep:
@@ -220,6 +277,16 @@ class CompiledTrainStep:
         self._metric_acc = None
         self._metric_traced_ids = set()
         self._metric_rejected = None  # metric whose device mirror failed
+        # retrace instrumentation (analysis.RetracePass): the step body
+        # increments trace_count only while jax traces it; every program
+        # (re)build bumps programs_built, so trace_count > programs_built
+        # means a jit cache miss at an already-built signature — dtype /
+        # weak-type drift.  compiled_hlo/artifact lowerings set _probing
+        # and don't count (the metric eval_shape probe does: it shares
+        # the trace cache the real call hits).
+        self.trace_count = 0
+        self.programs_built = 0
+        self._probing = False
         self._fns = {}
         self._fn = self._build(exec_group)
         self._fns[id(exec_group.exec_)] = (self._fn, exec_group.exec_)
@@ -334,6 +401,8 @@ class CompiledTrainStep:
 
         def step(params, slots, aux, mstate, data, lrs, wds, rescale, clip,
                  extra, rng):
+            if not self._probing:
+                self.trace_count += 1
             castp = {n: cast(v) for n, v in params.items()}
             # labels keep their dtype (integer class ids beyond bf16's exact
             # range must survive); only data inputs are cast
@@ -373,6 +442,7 @@ class CompiledTrainStep:
                 mstate = macc.update(mstate, labels, list(outs))
             return new_params, new_slots, new_aux, outs, mstate
 
+        self.programs_built += 1
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
@@ -431,6 +501,8 @@ class CompiledTrainStep:
             # errors below propagate untouched.
             import jax
 
+            # (the probe trace is the program's one trace — eval_shape on
+            # a jitted fn populates the cache the real call below hits)
             try:
                 jax.eval_shape(fn, self.params, self.slots, self.aux,
                                mstate, data, lrs, wds, rescale, clip,
@@ -452,31 +524,19 @@ class CompiledTrainStep:
         self.num_steps += 1
         return outs
 
-    def compiled_hlo(self, group=None):
-        """Optimized-HLO text of the fused train-step program (None before
-        the first ``run``).
-
-        Same probe surface as ``Executor.compiled_hlo`` — feed it to
-        ``parallel.hlo_stats.collective_stats`` — but over the program
-        that actually trains: forward + backward + optimizer in the one
-        donated jit.  Avals (+shardings) are rebuilt from the live master
-        store and the executor's bound input buffers, so nothing extra is
-        retained on the hot path; the lowering compiles a throwaway copy
-        of the program (cached jit executables are keyed by concrete
-        arrays, not avals), so this is a probe, not a free read.
+    def _abstract_args(self, group):
+        """Aval pytree of the step program's arguments, rebuilt from the
+        live master store and the executor's bound input buffers (None
+        before the first ``run``).  Shared by the ``compiled_hlo`` and
+        ``artifact`` probes so nothing extra is retained on the hot path.
         """
         import jax
 
         from . import random as _rnd
+        from .analysis.artifact import aval_of as _aval
 
-        group = group if group is not None else self._group
         if self._hyper_cache is None:
             return None  # never run: no hyper avals to rebuild
-        fn = self._entry_for(group)
-
-        def _aval(x):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                        sharding=x.sharding)
 
         params = {n: _aval(v) for n, v in self.params.items()}
         slots = {n: tuple(_aval(s) for s in v)
@@ -496,15 +556,74 @@ class CompiledTrainStep:
         lrs, wds, rescale, clip, extra = map(_aval, self._hyper_cache[5])
         import jax.tree_util as jtu
 
+        # metric accumulator avals carry NO sharding: after a drain the
+        # accumulator is re-seeded as uncommitted default-device scalars,
+        # which the real call relocates freely — snapshotting that
+        # placement into a committed aval would clash with mesh-sharded
+        # params at lower() time
         mstate = () if self._metric_acc is None or \
             self._metric_acc.state is None \
-            else jtu.tree_map(_aval, self._metric_acc.state)
+            else jtu.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape,
+                                                             x.dtype),
+                              self._metric_acc.state)
         # peek the key chain for its aval — a probe must not advance the
         # global RNG (split_key() here would shift every later step's
         # randomness and break bit-reproducibility around the probe)
         rng = _aval(_rnd._key())
-        return fn.lower(params, slots, aux, mstate, data, lrs, wds, rescale,
-                        clip, extra, rng).compile().as_text()
+        return (params, slots, aux, mstate, data, lrs, wds, rescale, clip,
+                extra, rng)
+
+    def compiled_hlo(self, group=None):
+        """Optimized-HLO text of the fused train-step program (None before
+        the first ``run``).
+
+        Same probe surface as ``Executor.compiled_hlo`` — feed it to
+        ``parallel.hlo_stats.collective_stats`` — but over the program
+        that actually trains: forward + backward + optimizer in the one
+        donated jit.  The lowering compiles a throwaway copy of the
+        program (cached jit executables are keyed by concrete arrays, not
+        avals), so this is a probe, not a free read.
+        """
+        group = group if group is not None else self._group
+        args = self._abstract_args(group)
+        if args is None:
+            return None
+        fn = self._entry_for(group)
+        self._probing = True
+        try:
+            return fn.lower(*args).compile().as_text()
+        finally:
+            self._probing = False
+
+    def artifact(self, name="train_step", group=None):
+        """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
+        fused step — jaxpr + lowered StableHLO + compiled HLO + the
+        donation/retrace/dtype metadata the analysis passes check (None
+        before the first ``run``)."""
+        import jax.tree_util as jtu
+
+        from .analysis.artifact import artifact_from_jit
+
+        group = group if group is not None else self._group
+        args = self._abstract_args(group)
+        if args is None:
+            return None
+        fn = self._entry_for(group)
+        params, slots, aux, mstate = args[0], args[1], args[2], args[3]
+        donated = len(jtu.tree_leaves((params, slots, aux, mstate)))
+        mesh_shape = dict(group._mesh.shape) if group._mesh is not None \
+            else None
+        count, built = self.trace_count, self.programs_built
+        self._probing = True
+        try:
+            return artifact_from_jit(
+                fn, args, name=name, donated_leaves=donated,
+                compute_dtype=str(self._cdtype) if self._cdtype is not None
+                else None,
+                mesh_shape=mesh_shape, trace_count=count,
+                expected_traces=built, num_steps=self.num_steps)
+        finally:
+            self._probing = False
 
     def _place(self, arr, name, group=None):
         import jax

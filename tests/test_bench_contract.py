@@ -139,3 +139,42 @@ def test_bench_decode_smoke_contract():
             if ln.strip().startswith("{")]
     phases = {r.get("phase") for r in rows}
     assert {"flops", "prefill", "decode", "naive", "serve"} <= phases, phases
+
+
+def test_mxlint_smoke_contract():
+    """`tools/mxlint.py --smoke` must audit all five canonical programs
+    with all five passes and report ZERO unsuppressed findings — the
+    static-analysis acceptance line: donation aliasing, collective
+    budgets, retrace counts, host-sync lint and FLOP/dtype coverage all
+    green against benchmarks/budgets.json on the 8-virtual-device CPU
+    platform."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # scrub analysis knobs: the smoke must measure the committed budget
+    # file with no ambient suppressions
+    for key in [k for k in env if k.startswith("MXNET_ANALYSIS_")]:
+        env.pop(key)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-3000:])
+
+    # stdout: exactly one JSON line, the bench.py metric contract
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    head = json.loads(lines[0])
+    assert head["metric"] == "mxlint_unsuppressed_findings"
+    assert head["unit"] == "findings"
+    assert head["value"] == 0 and head["vs_baseline"] == 1.0, head
+    assert head["errors"] == 0 and head["warnings"] == 0, head
+    # every canonical program was built (the virtual mesh gives ring×TP)
+    assert head["programs"] == 5 and head["passes"] == 5, head
+    assert head["skipped_programs"] == [], head
+
+    # stderr: one JSON finding per line; every (pass, program) pair ran
+    rows = [json.loads(ln) for ln in proc.stderr.splitlines()
+            if ln.strip().startswith("{")]
+    pairs = {(r["pass"], r["program"]) for r in rows if "pass" in r}
+    assert len(pairs) == 25, sorted(pairs)
+    assert all(r["severity"] == "info" for r in rows if "pass" in r), rows

@@ -1,0 +1,200 @@
+"""HLO/StableHLO text-parser tests against canned snippets.
+
+The parsing layer (analysis/hlo_parse.py, re-exported as
+parallel/hlo_stats.py) backs every static invariant — collective budgets,
+donation aliasing, FLOP counting — so its corner cases get pinned here
+with real-shaped HLO lines: nested tuple shapes under TPU layout
+annotations, grouped async -start tuples, context-scalar filtering, the
+all-reduce-start flat-tuple layout, sub-byte dtypes, and the
+uncounted-op reporting for dot-like ops the FLOP counter cannot model.
+"""
+import pytest
+
+from mxnet_tpu.parallel.hlo_stats import (collective_stats, dot_flops,
+                                          dot_flops_report,
+                                          input_output_aliases, shape_bytes,
+                                          shape_bytes_report)
+
+
+# ---------------------------------------------------------------------------
+# shape_bytes / dtype widths
+# ---------------------------------------------------------------------------
+def test_shape_bytes_basic_and_tuple():
+    assert shape_bytes("f32[8,128]") == 8 * 128 * 4
+    assert shape_bytes("(bf16[4,4], s32[2])") == 4 * 4 * 2 + 2 * 4
+    assert shape_bytes("f32[]") == 4  # scalar
+
+
+def test_shape_bytes_subbyte_and_f8_dtypes():
+    # the dtypes that used to be silently skipped (satellite fix)
+    assert shape_bytes("s4[16]") == 8      # 4-bit, packed
+    assert shape_bytes("u4[15]") == 8      # rounds up per shape
+    assert shape_bytes("f8e4m3b11fnuz[32]") == 32
+    assert shape_bytes("f8e4m3fnuz[8]") == 8
+    assert shape_bytes("f8e5m2[8]") == 8
+    assert shape_bytes("f4e2m1fn[16]") == 8
+
+
+def test_shape_bytes_unknown_dtype_recorded_not_silent():
+    total, unknown = shape_bytes_report("(f32[8], f6e3m2[64], f99zz[2])")
+    assert total == 32              # known part still counted
+    assert unknown == ["f6e3m2", "f99zz"]
+    # identifier[index] strings (HLO metadata, arg names) are NOT shapes
+    total, unknown = shape_bytes_report('op_name="params[0]" mstate[1]')
+    assert total == 0 and unknown == []
+
+
+def test_shape_bytes_tpu_layout_annotations():
+    # layout suffixes must not confuse the dtype/dims extraction
+    s = "(f32[8,128]{1,0:T(8,128)}, bf16[4,4]{1,0:T(8,128)(2,1)})"
+    assert shape_bytes(s) == 8 * 128 * 4 + 4 * 4 * 2
+
+
+# ---------------------------------------------------------------------------
+# collective_stats: async -start tuple layouts
+# ---------------------------------------------------------------------------
+def test_all_reduce_start_flat_tuple_counts_every_buffer():
+    # all-reduce-start has the SYNC op's shape: a flat tuple of results
+    # when XLA combined several all-reduces — every buffer counts
+    hlo = """
+  %ars = (f32[128]{0}, f32[64]{0}) all-reduce-start(f32[128]{0} %a, f32[64]{0} %b), replica_groups={}
+  %ard = (f32[128]{0}, f32[64]{0}) all-reduce-done((f32[128]{0}, f32[64]{0}) %ars)
+"""
+    st = collective_stats(hlo)
+    assert st["all-reduce"]["count"] == 1
+    assert st["all-reduce"]["bytes"] == 128 * 4 + 64 * 4
+    assert st["overlappable"]["count"] == 1
+    assert st["overlappable"]["bytes"] == st["all-reduce"]["bytes"]
+
+
+def test_reduce_scatter_start_counts_result_only():
+    # (operand, result, ctx...) — counting the operand too would double
+    hlo = """
+  %rs = (f32[256]{0}, f32[64]{0}, u32[], u32[]) reduce-scatter-start(f32[256]{0} %x), dimensions={0}
+  %rsd = f32[64]{0} reduce-scatter-done((f32[256]{0}, f32[64]{0}, u32[], u32[]) %rs)
+"""
+    st = collective_stats(hlo)
+    assert st["reduce-scatter"]["count"] == 1
+    assert st["reduce-scatter"]["bytes"] == 64 * 4
+
+
+def test_grouped_async_start_nested_tuples_with_layouts():
+    # grouped all-gather: operands and results are themselves tuples,
+    # with TPU layout annotations nesting parens inside the shape
+    hlo = ("  %ag = ((f32[8]{0:T(256)}, f32[4]{0:T(256)}), "
+           "(f32[16]{0:T(256)}, f32[8]{0:T(256)}), u32[], u32[]) "
+           "all-gather-start((f32[8]{0} %a, f32[4]{0} %b)), dimensions={0}\n")
+    st = collective_stats(hlo)
+    assert st["all-gather"]["count"] == 1
+    # result pack only: 16*4 + 8*4
+    assert st["all-gather"]["bytes"] == 16 * 4 + 8 * 4
+
+
+def test_context_scalar_filtering_and_permute():
+    # collective-permute-start carries (operand, result, u32 ctx scalars):
+    # scalars must be filtered BEFORE picking parts[1] as the result
+    hlo = ("  %cp = (f32[32]{0}, f32[32]{0}, u32[], u32[]) "
+           "collective-permute-start(f32[32]{0} %x), "
+           "source_target_pairs={{0,1},{1,0}}\n")
+    st = collective_stats(hlo)
+    assert st["collective-permute"]["bytes"] == 32 * 4
+    # sync op for contrast: plain result shape
+    st2 = collective_stats(
+        "  %cp2 = f32[32]{0} collective-permute(f32[32]{0} %x), "
+        "source_target_pairs={{0,1}}\n")
+    assert st2["collective-permute"]["bytes"] == 32 * 4
+    assert st2["overlappable"]["count"] == 0
+
+
+def test_done_lines_not_double_counted():
+    hlo = """
+  %s = (f32[8]{0}, f32[8]{0}, u32[]) collective-permute-start(f32[8]{0} %x), source_target_pairs={{0,1}}
+  %d = f32[8]{0} collective-permute-done((f32[8]{0}, f32[8]{0}, u32[]) %s)
+"""
+    st = collective_stats(hlo)
+    assert st["collective-permute"]["count"] == 1
+    assert st["total"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# dot_flops: dialect coverage + uncounted-op reporting
+# ---------------------------------------------------------------------------
+def test_dot_flops_stablehlo_dot_general():
+    line = ("%3 = stablehlo.dot_general %1, %2, contracting_dims = [1] x [0] "
+            ": (tensor<8x128xf32>, tensor<128x32xf32>) -> tensor<8x32xf32>")
+    assert dot_flops(line) == 2 * 8 * 32 * 128
+
+
+def test_dot_flops_stablehlo_plain_dot():
+    # the non-general form (satellite fix): contraction = lhs last dim
+    line = ("%3 = stablehlo.dot %1, %2 : (tensor<8x128xf32>, "
+            "tensor<128x32xf32>) -> tensor<8x32xf32>")
+    rep = dot_flops_report(line)
+    assert rep["flops"] == 2 * 8 * 32 * 128
+    assert rep["dots"][0]["op"] == "stablehlo.dot"
+    assert rep["uncounted_ops"] == []
+
+
+def test_dot_flops_hlo_dot():
+    line = ("  %dot.3 = f32[8,512]{1,0} dot(f32[8,128]{1,0} %a, "
+            "f32[128,512]{1,0} %b), lhs_contracting_dims={1}, "
+            "rhs_contracting_dims={0}")
+    assert dot_flops(line) == 2 * 8 * 512 * 128
+
+
+def test_dot_flops_convolution_reported_uncounted():
+    # convolutions contribute zero FLOPs — but no longer silently
+    text = """
+%4 = stablehlo.convolution(%1, %2) dim_numbers = [b, f, 0, 1] : (tensor<1x3x8x8xf32>, tensor<4x3x3x3xf32>) -> tensor<1x4x6x6xf32>
+  %conv.1 = f32[1,4,6,6]{3,2,1,0} convolution(f32[1,3,8,8]{3,2,1,0} %x, f32[4,3,3,3]{3,2,1,0} %w), window={size=3x3}
+"""
+    rep = dot_flops_report(text)
+    assert rep["flops"] == 0
+    ops = {r["op"]: r["count"] for r in rep["uncounted_ops"]}
+    assert ops == {"stablehlo.convolution": 1, "convolution": 1}
+
+
+def test_dot_flops_malformed_dot_reported_uncounted():
+    # a dot line the parser cannot model must surface, not vanish
+    rep = dot_flops_report(
+        "%9 = stablehlo.dot_general %1, %2 : spanning multiple lines")
+    assert rep["flops"] == 0
+    assert rep["uncounted_ops"] == [{"op": "stablehlo.dot_general",
+                                     "count": 1}]
+
+
+def test_dot_flops_dtype_recorded():
+    line = ("%3 = stablehlo.dot_general %1, %2, contracting_dims = [1] x "
+            "[0] : (tensor<8x16xbf16>, tensor<16x4xbf16>) -> "
+            "tensor<8x4xbf16>")
+    rep = dot_flops_report(line)
+    assert rep["dots"][0]["dtype"] == "bf16"
+
+
+# ---------------------------------------------------------------------------
+# input_output_aliases
+# ---------------------------------------------------------------------------
+def test_input_output_aliases_parse():
+    txt = ("HloModule jit_step, is_scheduled=true, input_output_alias={ "
+           "{0}: (0, {}, may-alias), {1}: (2, {}, must-alias) }, "
+           "entry_computation_layout={(f32[8]{0})->f32[8]{0}}\n"
+           "ENTRY %main { ... }\n")
+    assert input_output_aliases(txt) == [((0,), 0), ((1,), 2)]
+
+
+def test_input_output_aliases_absent():
+    txt = "HloModule jit_f, entry_computation_layout={(f32[4]{0})->f32[4]{0}}\n"
+    assert input_output_aliases(txt) == []
+    assert input_output_aliases("no module header at all") == []
+
+
+def test_input_output_aliases_nested_output_index():
+    txt = ("HloModule m, input_output_alias={ {1,0}: (3, {0}, may-alias) }, "
+           "other={}\n")
+    assert input_output_aliases(txt) == [((1, 0), 3)]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))

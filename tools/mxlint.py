@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""mxlint — static program-analysis lint over the framework's canonical
+compiled programs.
+
+Builds the five canonical programs on the current backend (``--smoke``
+forces the 8-virtual-device CPU platform so the ring×TP mesh program
+exists on one box), snapshots each as a
+:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` (jaxpr + lowered
+StableHLO + compiled HLO + donation/retrace/dtype metadata), and runs the
+five analysis passes against the committed budget file:
+
+==================  =====================================================
+pass                invariant it pins
+==================  =====================================================
+donation            donated buffers alias in compiled input_output_alias
+collective-budget   collective counts/bytes <= benchmarks/budgets.json
+retrace             one jit trace per program shape (no cache-key drift)
+host-sync           no host-callback primitives / host-transfer HLO ops
+flop-dtype          dot_flops coverage; no f32 dots in bf16 programs
+==================  =====================================================
+
+Output follows the bench.py contract: ONE json line on stdout —
+``{"metric": "mxlint_unsuppressed_findings", "value", "unit",
+"vs_baseline", ...}`` — with per-finding detail json on stderr, one line
+each.  Exit is nonzero when any unsuppressed *error* finding survives,
+so CI fails on a dropped donation / budget overrun / retrace the same
+way it fails on a broken test.
+
+Workflow (docs/static_analysis.md):
+
+* ``tools/mxlint.py --smoke``           — the tier-1 CI entry
+  (tests/test_bench_contract.py invokes it);
+* ``tools/mxlint.py --update-budgets``  — re-measure and rewrite the
+  budget ceilings after an *intentional* sharding/collective change
+  (preserves the file's suppressions list);
+* ``tools/mxlint.py --programs decode_step --text``  — human-readable
+  audit of a subset while iterating.
+
+Suppressions: ``pass[:program[:code]]`` globs, from the budget file's
+``suppressions`` list, ``MXNET_ANALYSIS_SUPPRESS``, or ``--suppress``.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SMOKE = "--smoke" in sys.argv
+
+# the virtual-device mesh must exist BEFORE jax initializes its backend
+# (same dance as benchmarks/bench_long_context.py / tests/conftest.py)
+if SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+if os.environ.get("JAX_PLATFORMS", "") == "cpu" and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+if SMOKE:
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    try:
+        _jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description="static analysis over the canonical "
+        "compiled programs (see docs/static_analysis.md)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 CI mode: force the 8-virtual-device CPU "
+                    "platform and audit all five programs")
+    ap.add_argument("--programs", default="",
+                    help="comma-filter of canonical programs (default all)")
+    ap.add_argument("--budgets", default="",
+                    help="budget file path (default: MXNET_ANALYSIS_BUDGETS "
+                    "or benchmarks/budgets.json)")
+    ap.add_argument("--suppress", default="",
+                    help="extra suppression patterns, comma-separated")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="rewrite the budget file's per-program collective "
+                    "ceilings from this run's measurements and exit")
+    ap.add_argument("--text", action="store_true",
+                    help="human-readable report on stderr instead of "
+                    "per-finding json lines")
+    ap.add_argument("--list", action="store_true", dest="list_only",
+                    help="list canonical programs and passes, then exit")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+
+    if args.smoke and not SMOKE:
+        # platform forcing happens at import, keyed off sys.argv; a
+        # programmatic main(["--smoke"]) after the backend initialized
+        # cannot deliver the promised 8-device CPU audit — fail loudly
+        # instead of silently skipping ring_tp_step
+        import jax
+
+        if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
+            sys.exit("--smoke requires the 8-virtual-device CPU platform, "
+                     "which must be forced before jax initializes: run "
+                     "tools/mxlint.py as a script, not via main()")
+
+    from mxnet_tpu import analysis
+    from mxnet_tpu.analysis.hlo_parse import collective_stats
+    from mxnet_tpu.analysis.programs import (CANONICAL_PROGRAMS,
+                                             build_canonical_artifacts)
+    import bench as _bench
+
+    if args.list_only:
+        for name in CANONICAL_PROGRAMS:
+            print("program:", name)
+        for p in analysis.default_passes():
+            print("pass:", p.name)
+        return 0
+
+    names = [n for n in args.programs.split(",") if n] or None
+    artifacts, notes = build_canonical_artifacts(names)
+    for prog, reason in notes.items():
+        print(json.dumps({"skipped_program": prog, "reason": reason}),
+              file=sys.stderr)
+
+    budgets_path = args.budgets or None
+    budgets = analysis.load_budgets(budgets_path)
+
+    if args.update_budgets:
+        # same resolution as the read above — reads and writes must agree
+        path = analysis.resolve_budgets_path(budgets_path)
+        programs = budgets.setdefault("programs", {})
+        for art in artifacts:
+            if art.compiled_text is None:
+                continue
+            stats = collective_stats(art.compiled_text)
+            ceilings = {op: dict(v) for op, v in stats.items()
+                        if op != "overlappable"}
+            programs.setdefault(art.name, {})["collectives"] = ceilings
+        with open(path, "w") as f:
+            json.dump(budgets, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({"updated": os.path.relpath(path),
+                          "programs": sorted(p for p in programs)}),
+              file=sys.stderr)
+        return 0
+
+    report = analysis.run_passes(artifacts, budgets=budgets,
+                                 suppressions=args.suppress)
+    if args.text:
+        print(report.format_text(), file=sys.stderr)
+    else:
+        for f in report.findings:
+            print(json.dumps(f.to_dict()), file=sys.stderr)
+
+    s = report.summary()
+    unsup = len(report.unsuppressed)
+    print(_bench.contract_line(
+        "mxlint_unsuppressed_findings", unsup, "findings",
+        1.0 if unsup == 0 else 0.0,
+        errors=s["errors"], warnings=s["warnings"],
+        suppressed=s["suppressed"], programs=s["programs"],
+        passes=s["passes"], skipped_programs=sorted(notes)))
+    return 1 if report.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
