@@ -36,16 +36,16 @@ import os
 from .artifact import ProgramArtifact, artifact_from_jit
 from .framework import (Finding, Pass, Report, SEVERITIES, default_passes,
                         run_passes)
-from .passes import (CollectiveBudgetPass, DonationPass, FlopDtypePass,
-                     HostSyncPass, RetracePass)
+from .passes import (CacheBytesPass, CollectiveBudgetPass, DonationPass,
+                     FlopDtypePass, HostSyncPass, RetracePass)
 from .retrace import RetraceAuditor, arg_signature, signature_diff
 
 __all__ = [
-    "CollectiveBudgetPass", "DonationPass", "Finding", "FlopDtypePass",
-    "HostSyncPass", "Pass", "ProgramArtifact", "Report", "RetraceAuditor",
-    "RetracePass", "SEVERITIES", "arg_signature", "artifact_from_jit",
-    "default_passes", "load_budgets", "resolve_budgets_path", "run_passes",
-    "signature_diff",
+    "CacheBytesPass", "CollectiveBudgetPass", "DonationPass", "Finding",
+    "FlopDtypePass", "HostSyncPass", "Pass", "ProgramArtifact", "Report",
+    "RetraceAuditor", "RetracePass", "SEVERITIES", "arg_signature",
+    "artifact_from_jit", "default_passes", "load_budgets",
+    "resolve_budgets_path", "run_passes", "signature_diff",
 ]
 
 _DEFAULT_BUDGETS = os.path.join(

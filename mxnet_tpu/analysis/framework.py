@@ -181,12 +181,12 @@ def _is_suppressed(finding, triples):
 
 
 def default_passes():
-    """Fresh instances of the five shipped passes, in run order."""
-    from .passes import (CollectiveBudgetPass, DonationPass, FlopDtypePass,
-                         HostSyncPass, RetracePass)
+    """Fresh instances of the six shipped passes, in run order."""
+    from .passes import (CacheBytesPass, CollectiveBudgetPass, DonationPass,
+                         FlopDtypePass, HostSyncPass, RetracePass)
 
     return [DonationPass(), CollectiveBudgetPass(), RetracePass(),
-            HostSyncPass(), FlopDtypePass()]
+            HostSyncPass(), FlopDtypePass(), CacheBytesPass()]
 
 
 _SURFACE_ATTR = {"jaxpr": "jaxpr_text", "stablehlo": "stablehlo_text",
@@ -194,7 +194,7 @@ _SURFACE_ATTR = {"jaxpr": "jaxpr_text", "stablehlo": "stablehlo_text",
 
 
 def run_passes(artifacts, passes=None, budgets=None, suppressions=None):
-    """Drive ``passes`` (default: all five shipped passes) over
+    """Drive ``passes`` (default: all shipped passes) over
     ``artifacts`` and return a :class:`Report`.
 
     ``budgets`` is the parsed budget file (``benchmarks/budgets.json``

@@ -28,6 +28,7 @@ __all__ = [
     "input_output_aliases",
     "shape_bytes",
     "shape_bytes_report",
+    "shape_str",
 ]
 
 # Bit widths per HLO/StableHLO element type.  Sub-byte types (s4/u4, the
@@ -105,6 +106,39 @@ def shape_bytes(shape_str):
     return shape_bytes_report(shape_str)[0]
 
 
+# numpy/ml_dtypes names -> HLO element-type codes, the inverse direction of
+# _SHAPE_RE: renders python-side array metadata into the same 'dtype[dims]'
+# strings shape_bytes sizes, so static byte budgets (the decode cache-bytes
+# pass) share one width table with the program-text parsers.
+_NP_TO_HLO = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "bool": "pred",
+    "int64": "s64", "int32": "s32", "int16": "s16", "int8": "s8",
+    "uint64": "u64", "uint32": "u32", "uint16": "u16", "uint8": "u8",
+    "int4": "s4", "uint4": "u4", "int2": "s2", "uint2": "u2",
+    "float8_e4m3": "f8e4m3", "float8_e4m3fn": "f8e4m3fn",
+    "float8_e4m3fnuz": "f8e4m3fnuz", "float8_e4m3b11fnuz": "f8e4m3b11fnuz",
+    "float8_e5m2": "f8e5m2", "float8_e5m2fnuz": "f8e5m2fnuz",
+    "float8_e3m4": "f8e3m4", "float8_e8m0fnu": "f8e8m0fnu",
+    "float4_e2m1fn": "f4e2m1fn",
+    "complex64": "c64", "complex128": "c128",
+}
+
+
+def shape_str(shape, dtype):
+    """Render ``(shape, dtype)`` as the HLO ``'dtype[dims]'`` string the
+    byte accountants parse — e.g. ``shape_str((2, 16, 8), jnp.int8)`` ->
+    ``'s8[2,16,8]'``.  Unknown dtypes raise (a silent zero would defeat
+    the budget)."""
+    import numpy as _np
+
+    name = _np.dtype(dtype).name
+    code = _NP_TO_HLO.get(name)
+    if code is None:
+        raise KeyError("no HLO element-type code for dtype %r" % name)
+    return "%s[%s]" % (code, ",".join(str(int(d)) for d in shape))
+
+
 def _split_top_level(tuple_str):
     """Split '(a, (b, c), d)' into top-level elements ['a', '(b, c)', 'd']."""
     s = tuple_str.strip()
@@ -174,10 +208,32 @@ _SH_DOT_RE = re.compile(
 _HLO_DOT_RE = re.compile(
     r"=\s*([a-z][a-z0-9]+\[[0-9,]*\])\S*\s+dot\(\s*([a-z][a-z0-9]+\[[0-9,]*\])"
     r".*?lhs_contracting_dims=\{([0-9,]*)\}")
+# stablehlo convolution: '%4 = stablehlo.convolution(%1, %2)
+#   dim_numbers = [b, 0, 1, f]x[0, 1, i, o]->[b, 0, 1, f], window = {...}
+#   {feature_group_count = 1 : i64, ...} : (tensor<1x8x8x3xf32>,
+#   tensor<3x3x3x16xf32>) -> tensor<1x6x6x16xf32>'.  The FLOP model reads
+# the RHS (kernel) dim roles from the middle dim_numbers group: per output
+# element the contraction is i x spatial (the kernel's i dim is already
+# C_in/groups in the IR, so feature_group_count needs no special casing).
+_SH_CONV_RE = re.compile(
+    r"stablehlo\.convolution\b.*?dim_numbers\s*=\s*\[[^\]]*\]\s*x\s*"
+    r"\[([^\]]*)\]\s*->"
+    r".*?:\s*\(tensor<([^>]+)>\s*,\s*tensor<([^>]+)>\s*\)"
+    r"\s*->\s*tensor<([^>]+)>")
+# HLO convolution: '%conv = f32[1,16,6,6]{...} convolution(
+#   f32[1,3,8,8]{...} %x, f32[16,3,3,3]{...} %w), window={size=3x3},
+#   dim_labels=bf01_oi01->bf01' — kernel dim roles from the middle
+# dim_labels group (chars: o, i, spatial digits).
+_HLO_CONV_RE = re.compile(
+    r"=\s*([a-z][a-z0-9]+\[[0-9,]*\])\S*\s+convolution\("
+    r"[^(]*?,\s*([a-z][a-z0-9]+\[[0-9,]*\])"
+    r".*?dim_labels=[a-z0-9]+_([a-z0-9]+)->")
+
 # dot-like ops the counter knows it does NOT model: any appearance goes to
 # the report's uncounted_ops so a program using them cannot silently read
-# as zero FLOPs.  HLO 'dot(' lines missing contracting-dims metadata and
-# unparseable stablehlo dot forms are appended dynamically.
+# as zero FLOPs.  HLO 'dot(' lines missing contracting-dims metadata,
+# label-less convolutions and unparseable stablehlo dot forms are appended
+# dynamically.
 _UNCOUNTED_RE = re.compile(
     r"(stablehlo\.convolution\b"
     r"|(?<![-\w])convolution\("
@@ -221,6 +277,24 @@ def _prod(dims):
     return n
 
 
+def _conv_contraction(rhs_dims, rhs_spec):
+    """Per-output-element multiply count of a convolution: the kernel's
+    ``i`` dim (already C_in / feature_group_count in both dialects) times
+    its spatial dims.  ``rhs_spec`` is the kernel dim-role string — a
+    stablehlo ``dim_numbers`` group like ``'0, 1, i, o'`` or an HLO
+    ``dim_labels`` group like ``'oi01'``.  Returns None (-> uncounted)
+    when the roles don't line up with the shape."""
+    roles = [t for t in re.split(r"[,\s]+", rhs_spec.strip()) if t] \
+        if "," in rhs_spec or " " in rhs_spec else list(rhs_spec.strip())
+    if len(roles) != len(rhs_dims) or "i" not in roles:
+        return None
+    contraction = 1
+    for role, dim in zip(roles, rhs_dims):
+        if role != "o":
+            contraction *= dim
+    return contraction
+
+
 def dot_flops_report(program_text):
     """Structured matmul-FLOP accounting of a lowered program.
 
@@ -228,15 +302,18 @@ def dot_flops_report(program_text):
 
     * ``flops`` — total 2 * result elements * contraction size over every
       parsed dot (StableHLO ``dot_general`` and non-general ``dot``, HLO
-      ``dot(`` lines; fusion bodies included);
+      ``dot(`` lines; fusion bodies included) and convolution (either
+      dialect: contraction = kernel i-dim x spatial dims, read from
+      ``dim_numbers``/``dim_labels`` — grouped convs need no special
+      casing, the IR kernel's i dim is already C_in/groups);
     * ``dots`` — one record per parsed line: ``{"op", "dtype"
       (result element type), "flops", "line"}`` — the dtype-lint pass
       reads these to flag f32 dots inside bf16 programs;
     * ``uncounted_ops`` — dot-like ops the counter saw but could not
-      model (``convolution`` in either dialect, malformed dot lines),
-      as ``{"op", "count"}`` aggregates.  A non-empty list means
-      ``flops`` is a floor, not a total — the FLOP-coverage pass turns
-      it into an error.
+      model (label-less convolutions, malformed dot lines), as
+      ``{"op", "count"}`` aggregates.  A non-empty list means ``flops``
+      is a floor, not a total — the FLOP-coverage pass turns it into an
+      error.
     """
     total = 0
     dots = []
@@ -281,6 +358,30 @@ def dot_flops_report(program_text):
             dots.append({"op": "dot", "dtype": _bracket_dtype(m.group(1)),
                          "flops": flops, "line": line.strip()})
             continue
+        m = _SH_CONV_RE.search(line)
+        if m is not None:
+            contraction = _conv_contraction(_tensor_dims(m.group(3)),
+                                            m.group(1))
+            if contraction is not None:
+                out = _tensor_dims(m.group(4))
+                flops = 2 * _prod(out) * contraction
+                total += flops
+                dots.append({"op": "stablehlo.convolution",
+                             "dtype": _tensor_dtype(m.group(4)),
+                             "flops": flops, "line": line.strip()})
+                continue
+        m = _HLO_CONV_RE.search(line)
+        if m is not None:
+            contraction = _conv_contraction(_bracket_dims(m.group(2)),
+                                            m.group(3))
+            if contraction is not None:
+                out = _bracket_dims(m.group(1))
+                flops = 2 * _prod(out) * contraction
+                total += flops
+                dots.append({"op": "convolution",
+                             "dtype": _bracket_dtype(m.group(1)),
+                             "flops": flops, "line": line.strip()})
+                continue
         m = _UNCOUNTED_RE.search(line)
         if m is not None:
             _count_uncounted(_UNCOUNTED_NAMES[m.group(1)])

@@ -1,4 +1,4 @@
-"""The five shipped analysis passes.
+"""The six shipped analysis passes.
 
 Each pass statically audits one performance invariant the framework's PRs
 established, so a sharding-rule edit or a jit cache-key drift fails CI on
@@ -16,6 +16,11 @@ the 8-virtual-device CPU mesh instead of silently regressing a headline:
   guard is the dynamic half).
 * :class:`FlopDtypePass` — ``dot_flops`` coverage (uncounted dot-like ops
   are an error, not a silent zero) and f32 dots inside bf16 programs.
+* :class:`CacheBytesPass` — decode KV-cache bytes (data + scale planes,
+  sized through the f8/sub-byte-aware width table) stay within the
+  committed ceiling, and a quantized config must actually store narrow
+  data (an f32 data plane under MXNET_KV_DTYPE is an error — decode is
+  bandwidth-bound on exactly these bytes).
 """
 from __future__ import annotations
 
@@ -24,7 +29,7 @@ from .hlo_parse import (collective_stats, dot_flops_report,
                         input_output_aliases, shape_bytes_report)
 
 __all__ = ["DonationPass", "CollectiveBudgetPass", "RetracePass",
-           "HostSyncPass", "FlopDtypePass"]
+           "HostSyncPass", "FlopDtypePass", "CacheBytesPass"]
 
 
 class DonationPass(Pass):
@@ -307,4 +312,81 @@ class FlopDtypePass(Pass):
                 "%d dot(s), %d FLOPs, full coverage" %
                 (len(report["dots"]), report["flops"]),
                 code="covered", flops=report["flops"]))
+        return findings
+
+
+# dtypes a cache DATA plane may use under a quantized MXNET_KV_DTYPE; the
+# fp32 scale plane rides separately and is counted in cache_bytes
+_NARROW_CACHE_DTYPES = ("int8", "float8_e4m3fn", "float8_e5m2",
+                        "float8_e4m3fnuz", "float8_e5m2fnuz", "int4")
+
+
+class CacheBytesPass(Pass):
+    """Decode KV-cache bytes vs the committed ceiling; quantized configs
+    must store narrow data.
+
+    Decode is bandwidth-bound on the cache: every step streams the whole
+    (B, C, E) K/V per layer, so cache bytes ARE the serving-cost
+    denominator (``bench_decode.py``'s tokens/s/GB headline).  The
+    decode-layer artifacts record ``meta['cache_bytes']`` — data plus
+    per-(token, head) scale planes, sized statically through
+    ``hlo_parse.shape_bytes``'s width table (f8/sub-byte aware) — and
+    ``meta['kv_dtype']``/``meta['cache_data_dtypes']``.  Budget layout::
+
+        {"programs": {"<program>": {"cache_bytes": N}}}
+
+    Findings: bytes over the ceiling = error (a dtype regression silently
+    doubling the cache); a quantized ``kv_dtype`` whose data planes are
+    full-precision = error (the quantize plumbing got dropped — the
+    config promises narrow reads it no longer performs); no committed
+    ceiling = warning nudging ``--update-budgets`` hygiene.  Programs
+    without cache metadata (training steps) skip with an info row.
+    """
+
+    name = "cache-bytes"
+    requires = ()
+
+    def run(self, artifact, context):
+        cache_bytes = artifact.meta.get("cache_bytes")
+        if cache_bytes is None:
+            return [self.finding(
+                artifact, "info", "no KV-cache metadata; pass skipped",
+                code="no-cache")]
+        findings = []
+        kv_dtype = artifact.meta.get("kv_dtype")
+        data_dtypes = artifact.meta.get("cache_data_dtypes") or []
+        if kv_dtype:
+            wide = [d for d in data_dtypes
+                    if d not in _NARROW_CACHE_DTYPES]
+            if wide:
+                findings.append(self.finding(
+                    artifact, "error",
+                    "kv_dtype=%s promises quantized caches but data "
+                    "planes store %s — the quantize path was dropped and "
+                    "every decode step streams full-precision bytes"
+                    % (kv_dtype, wide),
+                    code="f32-cache", kv_dtype=kv_dtype, wide=wide))
+        budget = context.budget_for(artifact.name) or {}
+        ceiling = budget.get("cache_bytes")
+        if ceiling is None:
+            findings.append(self.finding(
+                artifact, "warning",
+                "no committed cache-byte budget for this program "
+                "(measured: %d bytes) — run tools/mxlint.py "
+                "--update-budgets" % cache_bytes,
+                code="no-budget", measured=cache_bytes))
+        elif cache_bytes > ceiling:
+            findings.append(self.finding(
+                artifact, "error",
+                "cache bytes over budget: %d > %d — the per-token "
+                "bandwidth bill grew (dtype or shape regression in the "
+                "ring buffers)" % (cache_bytes, ceiling),
+                code="over-budget", measured=cache_bytes, budget=ceiling))
+        if not findings:
+            findings.append(self.finding(
+                artifact, "info",
+                "cache within budget: %d <= %d bytes (kv_dtype=%s)"
+                % (cache_bytes, ceiling, kv_dtype or "full-precision"),
+                code="within-budget", measured=cache_bytes,
+                budget=ceiling, kv_dtype=kv_dtype))
         return findings

@@ -1,6 +1,6 @@
 """Builders for the canonical programs the lint audits.
 
-``tools/mxlint.py`` (and the tier-1 smoke) checks five programs — the
+``tools/mxlint.py`` (and the tier-1 smoke) checks eight programs — the
 compiled surfaces behind every headline number so far:
 
 * ``train_step``  — the fused forward+backward+optimizer program
@@ -9,6 +9,13 @@ compiled surfaces behind every headline number so far:
   ``score()`` arms (donated accumulator state);
 * ``prefill``     — the KV-cache prefill program;
 * ``decode_step`` — the donated one-token decode program;
+* ``decode_step_q`` — the same decode step over int8-quantized KV caches
+  (per-head scale planes; the cache-bytes pass checks the data planes
+  really are narrow);
+* ``draft_step``  — the DRAFT model's donated decode step inside the
+  speculative serving loop (a second, smaller DecodePredictor);
+* ``verify_step`` — the speculative verify program: k+1 positions scored
+  in one pass against the quantized caches, acceptance-rejection inside;
 * ``ring_tp_step`` — the attention-LM fused step on the composed
   (data, seq, model) mesh: ring attention with head groups sharded on
   'model' (needs >= 4 devices; the smoke forces the 8-virtual-device
@@ -16,9 +23,14 @@ compiled surfaces behind every headline number so far:
 
 Every program is driven at least twice at identical shapes before its
 artifact is snapshotted, so the retrace pass checks a real "second call
-hit the jit cache" fact, not a vacuous first-trace count.  Dims are tiny:
-the point is the *program structure* (collectives, aliasing, callbacks,
-dot dtypes), which does not depend on size.
+hit the jit cache" fact, not a vacuous first-trace count.  The three
+speculative/quantized programs are driven by an actual MIXED-LENGTH
+:class:`~mxnet_tpu.decode.DecodeServer` run (draft-model proposer,
+prompts of different lengths, slot reuse), so their one-trace-each
+retrace audit covers the real serving schedule, not a synthetic drive.
+Dims are tiny: the point is the *program structure* (collectives,
+aliasing, callbacks, dot dtypes, cache bytes), which does not depend on
+size.
 """
 from __future__ import annotations
 
@@ -29,12 +41,16 @@ from ..base import MXNetError
 __all__ = ["CANONICAL_PROGRAMS", "build_canonical_artifacts"]
 
 CANONICAL_PROGRAMS = ("train_step", "eval_step", "prefill", "decode_step",
+                      "decode_step_q", "draft_step", "verify_step",
                       "ring_tp_step")
 
 # tiny-but-structured dims shared by every builder
 _MLP = dict(batch=8, features=32, hidden=32, classes=8)
 _LM = dict(vocab=32, seq_len=16, embed=16, heads=4, ffn=32, layers=1,
            batch=2)
+# the draft model: same vocabulary, narrower/shallower stack
+_DRAFT = dict(embed=8, heads=2, ffn=16, layers=1)
+_SPEC_K = 3
 
 
 def _mlp_module(compute_dtype="bfloat16"):
@@ -132,6 +148,20 @@ def _eval_artifact(mod, batch):
         step.finish()
 
 
+def _lm_params(sym, batch, seq_len, seed=0, scale=0.02):
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(batch, seq_len), softmax_label=(batch, seq_len))
+    params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params[name] = rng.normal(0, scale, shape).astype(np.float32)
+    for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+        params["aux:" + name] = np.zeros(shape, np.float32)
+    return params
+
+
 def _decode_artifacts():
     from mxnet_tpu.decode import DecodePredictor
 
@@ -140,18 +170,9 @@ def _decode_artifacts():
     d = _LM
     rng = np.random.RandomState(0)
     sym = _lm_symbol()
-    arg_shapes, _, aux_shapes = sym.infer_shape(
-        data=(d["batch"], d["seq_len"]),
-        softmax_label=(d["batch"], d["seq_len"]))
-    params = {}
-    for name, shape in zip(sym.list_arguments(), arg_shapes):
-        if name in ("data", "softmax_label"):
-            continue
-        params[name] = rng.normal(0, 0.02, shape).astype(np.float32)
-    for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
-        params["aux:" + name] = np.zeros(shape, np.float32)
+    params = _lm_params(sym, d["batch"], d["seq_len"])
     pred = DecodePredictor(sym, params, cache_len=d["seq_len"],
-                           temperature=0.0)
+                           temperature=0.0, kv_dtype="")
     prompt_len = d["seq_len"] // 2
     prompts = rng.randint(0, d["vocab"],
                           size=(d["batch"], d["seq_len"])) \
@@ -166,6 +187,61 @@ def _decode_artifacts():
             pred.decode_artifact(state))
 
 
+def _speculative_artifacts():
+    """decode_step_q / draft_step / verify_step, driven by a real
+    mixed-length speculative serve.
+
+    An int8-quantized target and a smaller draft model run a
+    :class:`~mxnet_tpu.decode.DecodeServer` queue of different-length
+    prompts (slot reuse included) — the mixed-length serve run the
+    retrace acceptance criterion names; each program's trace counter must
+    then read exactly one when its artifact snapshots.
+    """
+    from mxnet_tpu.decode import DecodePredictor, DecodeServer, DraftProposer
+    from mxnet_tpu.models import attention_lm
+
+    import jax
+
+    d = _LM
+    dd = _DRAFT
+    rng = np.random.RandomState(1)
+    target = DecodePredictor(_lm_symbol(), _lm_params(
+        _lm_symbol(), d["batch"], d["seq_len"]), cache_len=d["seq_len"],
+        temperature=0.0, kv_dtype="int8")
+    draft_sym = attention_lm.get_symbol(
+        vocab_size=d["vocab"], seq_len=d["seq_len"],
+        num_layers=dd["layers"], embed=dd["embed"], heads=dd["heads"],
+        ffn_hidden=dd["ffn"])
+    draft = DecodePredictor(
+        draft_sym, _lm_params(draft_sym, d["batch"], d["seq_len"], seed=2),
+        cache_len=d["seq_len"], temperature=0.0, kv_dtype="")
+    proposer = DraftProposer(draft, _SPEC_K)
+    server = DecodeServer(target, max_prefill=d["seq_len"] // 2,
+                          slots=d["batch"], max_new_tokens=4,
+                          proposer=proposer)
+    for n in (3, 5, 7, 4):          # mixed-length trace, 2x slot reuse
+        server.submit(rng.randint(0, d["vocab"], size=(n,)))
+    results = server.run()
+    if len(results) != 4 or server.spec_steps == 0:
+        raise MXNetError("speculative serve drive did not exercise the "
+                         "verify program (results=%d, spec_steps=%d)"
+                         % (len(results), server.spec_steps))
+
+    # the plain quantized decode step is the serve loop's near-wrap
+    # fallback; drive it twice at the serve batch shape for its artifact
+    key = jax.random.PRNGKey(0)
+    prompts = rng.randint(0, d["vocab"],
+                          size=(d["batch"], d["seq_len"] // 2)) \
+        .astype(np.float32)
+    state, _ = target.prefill(prompts, d["seq_len"] // 2, key)
+    state, _ = target.step(state, key)
+    state, _ = target.step(state, key)
+    return (target.decode_artifact(state, name="decode_step_q"),
+            proposer.predictor.decode_artifact(proposer._state,
+                                               name="draft_step"),
+            target.verify_artifact(state, _SPEC_K, name="verify_step"))
+
+
 def _ring_mesh_config(n_dev):
     from mxnet_tpu.parallel import MeshConfig
 
@@ -177,7 +253,7 @@ def _ring_mesh_config(n_dev):
 
 
 def build_canonical_artifacts(names=None):
-    """Build the requested canonical artifacts (default: all five).
+    """Build the requested canonical artifacts (default: all eight).
 
     Returns ``(artifacts, notes)`` — ``notes`` maps a program that could
     not be built on this host (e.g. ``ring_tp_step`` without >= 4
@@ -209,6 +285,15 @@ def build_canonical_artifacts(names=None):
             artifacts.append(prefill)
         if "decode_step" in want:
             artifacts.append(decode)
+
+    if {"decode_step_q", "draft_step", "verify_step"} & set(want):
+        decode_q, draft, verify = _speculative_artifacts()
+        if "decode_step_q" in want:
+            artifacts.append(decode_q)
+        if "draft_step" in want:
+            artifacts.append(draft)
+        if "verify_step" in want:
+            artifacts.append(verify)
 
     if "ring_tp_step" in want:
         cfg = _ring_mesh_config(len(jax.devices()))

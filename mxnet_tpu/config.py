@@ -183,6 +183,30 @@ register("MXNET_DECODE_DONATE", bool, True,
          "decode-step program so XLA appends in place — zero steady-state "
          "allocation in the token loop.  0 keeps the inputs alive across "
          "the call for debugging (inspect a cache mid-generation).")
+register("MXNET_KV_DTYPE", str, "",
+         "Storage dtype for the decode KV caches (decode.DecodePredictor): "
+         "'int8', 'float8_e4m3fn' ('f8e4m3') or 'float8_e5m2' ('f8e5m2') "
+         "quantize K/V in cache_append with per-(token, head) fp32 scales "
+         "and dequantize inside sdpa_decode/sdpa_verify, halving or "
+         "quartering the bytes every decode step streams from the cache — "
+         "decode's bandwidth bound.  Empty (default) stores full-precision "
+         "K/V.  The mxlint cache-bytes pass budgets the resulting cache "
+         "size and flags an f32 cache in a quantized config.")
+register("MXNET_SPEC_K", int, 0,
+         "Tokens drafted per speculative-decoding step (decode.DecodeServer "
+         "/ DecodePredictor.generate_speculative).  A proposer drafts k "
+         "tokens, ONE fixed-shape verify pass through the target scores "
+         "all k+1 positions, and the acceptance-rejection rule keeps the "
+         "output distribution exactly the target's — each step commits "
+         "1..k+1 tokens for one target forward.  0 (default) disables "
+         "speculation; the serving loop then takes the plain one-token "
+         "decode step.")
+register("MXNET_SPEC_NGRAM", int, 2,
+         "Suffix length the model-free n-gram proposer (decode."
+         "NGramProposer) matches against each sequence's own history "
+         "(prompt-lookup / self-speculation).  Longer suffixes propose "
+         "more conservatively: fewer matches, higher acceptance when one "
+         "hits.")
 register("MXNET_DECODE_MAX_NEW", int, 256,
          "Default cap on generated tokens per request in the serving loop "
          "when the caller gives no explicit max_new_tokens (a sequence "

@@ -32,6 +32,28 @@ batching) — new requests prefill into a free slot between decode steps,
 sequences retire on EOS/max-len, and the freed slot refills from the
 request queue, all without retracing anything.
 
+Decode is bandwidth-bound on the cache, so this module attacks both
+factors of ``bytes/token = passes/token x cache bytes``:
+
+* **Speculative decoding** (Leviathan et al. 2023): a proposer drafts k
+  tokens — a small draft model through a second ``DecodePredictor``
+  (:class:`DraftProposer`) or the model-free n-gram self-speculation
+  lookup (:class:`NGramProposer`) — and ONE batched verify pass
+  (``ops.attention.sdpa_verify``, fixed shape in k) scores all k+1
+  positions against the caches; ``ops.sample.speculative_accept``
+  commits the accepted prefix plus one resampled token, preserving the
+  target distribution exactly.  Rejection rolls back ``lens`` only (the
+  length mask hides the dead cache entries; the next append overwrites
+  them), and speculation gates off near the ring-wrap boundary (host-side
+  length bookkeeping, no extra device sync) so there is exactly ONE
+  draft program and ONE verify program — never a retrace.
+* **Quantized KV caches** (``MXNET_KV_DTYPE``: int8 / fp8 with
+  per-(token, head) scales, ``ops.attention.QuantKV``): ``cache_append``
+  quantizes on the way in, ``sdpa_decode``/``sdpa_verify`` dequantize per
+  head on the way out, and the cache bytes every step streams drop 2-4x.
+  Scale buffers shard like the caches (``tp_rules.kv_cache_pspec`` — an
+  H-split is the same head-group split).
+
 The symbol contract (checked at trace time, documented in
 docs/inference.md): decoder-only graphs built from position-independent ops
 plus ``dot_product_attention`` for sequence mixing, with at most a learned
@@ -49,7 +71,17 @@ from .base import MXNetError
 from . import context as ctx_mod
 from .registry import OpContext
 
-__all__ = ["DecodePredictor", "DecodeServer", "DecodeState"]
+__all__ = ["DecodePredictor", "DecodeServer", "DecodeState",
+           "NGramProposer", "DraftProposer"]
+
+# MXNET_KV_DTYPE spellings -> canonical jnp dtype names (resolved lazily so
+# the module imports without jax)
+_KV_DTYPES = {
+    "int8": "int8", "s8": "int8",
+    "float8_e4m3fn": "float8_e4m3fn", "f8e4m3": "float8_e4m3fn",
+    "f8e4m3fn": "float8_e4m3fn",
+    "float8_e5m2": "float8_e5m2", "f8e5m2": "float8_e5m2",
+}
 
 # broadcast ops through which a (1, S, E) position table may meet the
 # (B, t, E) activation stream; the decode walk gathers the table rows for
@@ -63,7 +95,9 @@ _POSITION_BROADCAST_OPS = {
 class DecodeState(NamedTuple):
     """The donated per-step serving state (a jax pytree)."""
 
-    caches: tuple       # ((k, v), ...) per attention node, each (B, C, E)
+    caches: tuple       # ((k, v), ...) per attention node: (B, C, E)
+                        # arrays, or ops.attention.QuantKV (data + scales)
+                        # under a quantized MXNET_KV_DTYPE
     lens: object        # (B,) int32 — tokens appended to each cache so far
     tok: object         # (B, 1) int32 — last sampled token, not yet appended
 
@@ -92,10 +126,15 @@ class DecodePredictor:
         Sampling knobs baked into the step program (0 = greedy).
     data_name : str
         The token-input variable; other free inputs (labels) are fed zeros.
+    kv_dtype : str, optional
+        KV-cache storage dtype: 'int8', 'float8_e4m3fn' or 'float8_e5m2'
+        (per-(token, head) scales, quantize-on-append / dequantize-in-
+        kernel).  ``None`` (default) reads ``MXNET_KV_DTYPE``; empty
+        string = full-precision caches.
     """
 
     def __init__(self, symbol, params, cache_len, ctx=None, mesh=None,
-                 temperature=0.0, top_k=0, data_name="data"):
+                 temperature=0.0, top_k=0, data_name="data", kv_dtype=None):
         import jax
         import jax.numpy as jnp
 
@@ -114,6 +153,21 @@ class DecodePredictor:
         self._temperature = float(temperature)
         self._top_k = int(top_k)
         self._data_name = data_name
+
+        from . import config as _config
+
+        if kv_dtype is None:
+            kv_dtype = _config.get("MXNET_KV_DTYPE")
+        kv_dtype = (kv_dtype or "").strip().lower()
+        if kv_dtype:
+            canonical = _KV_DTYPES.get(kv_dtype)
+            if canonical is None:
+                raise MXNetError(
+                    "unsupported MXNET_KV_DTYPE %r (supported: %s)"
+                    % (kv_dtype, sorted(set(_KV_DTYPES.values()))))
+            self._kv_dtype = jnp.dtype(canonical)
+        else:
+            self._kv_dtype = None
 
         arg_params, aux_params = _as_param_dicts(params)
         free = [n for n in symbol.list_arguments() if n not in arg_params]
@@ -169,12 +223,15 @@ class DecodePredictor:
         self._donate = bool(donate)
         # retrace instrumentation (analysis.RetracePass): the impl bodies
         # run only while jax traces them, so these counters check the
-        # serving loop's "zero retraces" claim — decode must trace ONCE,
-        # prefill once per admitted (B, P) shape.  Probes (lowering for
-        # artifact/FLOP text) set _probing and don't count.
-        self.trace_counts = {"prefill": 0, "decode": 0}
+        # serving loop's "zero retraces" claim — decode and verify must
+        # each trace ONCE, prefill once per admitted (B, P) shape.
+        # Probes (lowering for artifact/FLOP text) set _probing and don't
+        # count.
+        self.trace_counts = {"prefill": 0, "decode": 0, "verify": 0}
         self._probing = False
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=donate)
+        self._verify_fn = jax.jit(self._verify_impl, donate_argnums=donate)
+        self._verify_shapes = set()   # distinct (B, k, has_q) driven
         self._prefill_fns = {}   # (B, P) -> jitted prefill program
         # jnp dummies reused every call (sample_tokens at temperature 0
         # never reads the key, but the jit signature keeps it)
@@ -230,16 +287,18 @@ class DecodePredictor:
                     outs = [_attn.sdpa(q, k, v, num_heads=heads,
                                        causal=attrs.get("causal", False),
                                        scale=scale)]
-                    new_caches.append((self._fill_cache(k),
-                                       self._fill_cache(v)))
+                    new_caches.append((self._fill_cache(k, heads),
+                                       self._fill_cache(v, heads)))
                 else:
                     kc, vc = caches[ci]
                     ci += 1
-                    kc = _attn.cache_append(kc, k, pos0)
-                    vc = _attn.cache_append(vc, v, pos0)
+                    kc = _attn.cache_append(kc, k, pos0, num_heads=heads)
+                    vc = _attn.cache_append(vc, v, pos0, num_heads=heads)
                     pos = jnp.asarray(pos0, jnp.int32).reshape(-1)
-                    outs = [_attn.sdpa_decode(q, kc, vc, pos + t,
-                                              num_heads=heads, scale=scale)]
+                    sdpa_cached = _attn.sdpa_decode if t == 1 \
+                        else _attn.sdpa_verify
+                    outs = [sdpa_cached(q, kc, vc, pos + t,
+                                        num_heads=heads, scale=scale)]
                     new_caches.append((kc, vc))
             else:
                 if opname in _POSITION_BROADCAST_OPS and len(ins) == 2 \
@@ -278,27 +337,82 @@ class DecodePredictor:
                              "or (B, t, V)" % (out.shape,))
         return out, tuple(new_caches)
 
-    def _fill_cache(self, x):
+    def _fill_cache(self, x, num_heads=1):
         """(B, t, E) prefill K/V -> a (B, C, E) ring buffer holding the t
-        tokens at their ``pos % C`` slots (prefill enforces t <= C)."""
+        tokens at their ``pos % C`` slots (prefill enforces t <= C).
+        Under a quantized ``kv_dtype`` the buffer is an
+        ``ops.attention.QuantKV`` — data quantized per (token, head), pad
+        slots at a floor scale; the fp32 scale plane shards like the data
+        (``kv_cache_pspec`` — its trailing H dim is the same head-group
+        split as E)."""
         import jax
         import jax.numpy as jnp
+
+        from .ops import attention as _attn
 
         b, t, e = x.shape
         buf = jnp.zeros((b, self._cache_len, e), x.dtype)
         buf = jax.lax.dynamic_update_slice(buf, x, (0, 0, 0))
+        if self._kv_dtype is not None:
+            q = _attn.quantize_kv(buf, self._kv_dtype, num_heads)
+            if self._cache_sharding is not None:
+                q = _attn.QuantKV(
+                    jax.lax.with_sharding_constraint(q.data,
+                                                     self._cache_sharding),
+                    jax.lax.with_sharding_constraint(
+                        q.scale, self._scale_sharding(num_heads)))
+            return q
         if self._cache_sharding is not None:
             buf = jax.lax.with_sharding_constraint(buf, self._cache_sharding)
         return buf
+
+    @property
+    def _greedy(self):
+        from .ops.sample import is_greedy_policy
+
+        return is_greedy_policy(self._temperature, self._top_k)
+
+    def _scale_sharding(self, num_heads):
+        """Sharding for a (B, C, H) scale plane: the cache spec's head
+        axis when H divides it, else replicated heads.  The data plane's
+        E-split can be finer than a head split (E % axis == 0 with
+        heads % axis != 0 — legal, GSPMD handles the einsum), and the
+        tiny scale plane must not turn that config into a trace error."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = self._cache_sharding.spec
+        head_ax = spec[2]
+        if head_ax is not None and \
+                num_heads % dict(self._mesh.shape)[head_ax] != 0:
+            return NamedSharding(self._mesh, P(spec[0], None, None))
+        return self._cache_sharding
 
     def _sample(self, key, probs):
         import jax.numpy as jnp
 
         from .ops.sample import sample_tokens
 
+        if self._greedy:
+            # argmax(p) == argmax(log p): skip the log on the hot path
+            return jnp.argmax(probs, axis=-1).astype(jnp.int32)[:, None]
         logits = jnp.log(probs.astype(jnp.float32) + 1e-30)
         return sample_tokens(key, logits, self._temperature,
                              self._top_k)[:, None]
+
+    def _policy_probs(self, probs):
+        """The EXACT sampling distribution :meth:`_sample` draws from, as
+        explicit probability vectors — what speculative acceptance must
+        compare against.  Softmax of the SAME ``policy_logits`` the
+        sampler's categorical draws over (one implementation, so the two
+        cannot drift)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .ops.sample import policy_logits
+
+        logits = jnp.log(probs.astype(jnp.float32) + 1e-30)
+        return jax.nn.softmax(
+            policy_logits(logits, self._temperature, self._top_k), axis=-1)
 
     # ------------------------------------------------------------------
     # the two programs
@@ -323,6 +437,28 @@ class DecodePredictor:
         probs = probs3[:, 0]
         tok = self._sample(key, probs)
         return DecodeState(caches, state.lens + 1, tok), probs
+
+    def _verify_impl(self, env, state, draft_toks, draft_probs, key):
+        """ONE batched speculative verify pass: score the last committed
+        token + k drafts, accept a prefix, resample at the first
+        mismatch.  The cache gets all k+1 K/V appended at fixed width;
+        rejection rolls back ``lens`` only — slots past it are masked and
+        the next append overwrites them in place."""
+        import jax.numpy as jnp
+
+        from .ops.sample import speculative_accept
+
+        if not self._probing:
+            self.trace_counts["verify"] += 1
+        toks_in = jnp.concatenate(
+            [state.tok.astype(jnp.int32), draft_toks.astype(jnp.int32)],
+            axis=1)                                        # (B, k+1)
+        probs3, caches = self._run(env, toks_in, state.caches, state.lens)
+        pi = probs3 if self._greedy else self._policy_probs(probs3)
+        counts, out = speculative_accept(key, pi, draft_toks, draft_probs,
+                                         greedy=self._greedy)
+        tok = jnp.take_along_axis(out, (counts - 1)[:, None], axis=1)
+        return (DecodeState(caches, state.lens + counts, tok), out, counts)
 
     # ------------------------------------------------------------------
     # public surface
@@ -369,6 +505,134 @@ class DecodePredictor:
         """
         return self._decode_fn(self._env, state,
                                key if key is not None else self._zero_key)
+
+    def verify_step(self, state, draft_toks, draft_probs=None, key=None):
+        """One speculative macro-step: verify k drafted tokens in ONE
+        target forward, commit the accepted prefix plus a resampled
+        token.
+
+        ``draft_toks`` is (B, k) int32; ``draft_probs`` (B, k, V) are the
+        proposal distributions they were drawn from (``None`` for a
+        deterministic proposer — n-gram lookup or a greedy draft).
+        Returns ``(state', out_toks, counts)``: ``out_toks`` (B, k+1) are
+        the emitted tokens, valid through ``counts`` (B,) in [1, k+1];
+        ``state'.tok`` is the last emitted token, ``state'.lens`` advanced
+        by ``counts`` (rejection rollback — rejected cache entries stay
+        masked until overwritten).  The caller must keep the verify
+        window inside the ring: ``lens + k + 1 <= cache_len`` for every
+        live row (the serving loop's host-side gate).  Fixed shape in k —
+        one trace per (B, k, has-draft-probs) signature, donated like
+        :meth:`step`.
+        """
+        import jax.numpy as jnp
+
+        draft_toks = jnp.asarray(draft_toks, jnp.int32)
+        self._verify_shapes.add((draft_toks.shape[0], draft_toks.shape[1],
+                                 draft_probs is not None))
+        return self._verify_fn(self._env, state, draft_toks, draft_probs,
+                               key if key is not None else self._zero_key)
+
+    def generate_speculative(self, tokens, prompt_len=None,
+                             max_new_tokens=16, seed=0, eos_id=None,
+                             k=None, draft=None, proposer=None):
+        """Speculative :meth:`generate`: a (B, N) int32 array of sampled
+        tokens, but each loop iteration drafts ``k`` tokens and commits
+        1..k+1 of them through one verify pass.  With ``eos_id``, a row
+        retires AT its EOS — the speculation window's tail is discarded
+        (the serving loop's rule) and the row pads with its last token,
+        where plain :meth:`generate` keeps decoding garbage past EOS —
+        slice per row in both cases.
+
+        ``draft`` is an optional small draft model (a second
+        ``DecodePredictor`` over the same vocabulary — wrapped in a
+        :class:`DraftProposer`); without one, ``proposer`` defaults to the
+        model-free :class:`NGramProposer`.  Greedy sampling
+        (temperature=0) emits EXACTLY the target-only greedy sequence;
+        stochastic sampling preserves the target distribution (the
+        acceptance-rejection identity) though not the per-seed sample
+        path.  Near the ring-wrap boundary the loop falls back to plain
+        single-token steps — both programs already traced, so the
+        fallback never retraces.
+        """
+        import jax
+
+        from . import config as _config
+
+        if k is None:
+            k = int(_config.get("MXNET_SPEC_K")) or 4
+        k = int(k)
+        if k <= 0:
+            raise MXNetError("speculative k must be positive (got %d)" % k)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tokens = np.asarray(tokens)
+        b = tokens.shape[0]
+        if prompt_len is None:
+            prompt_len = tokens.shape[1]
+        lens_h = np.broadcast_to(
+            np.asarray(prompt_len, np.int64).reshape(-1), (b,)).copy()
+        state, _ = self.prefill(tokens, prompt_len, sub)
+
+        if proposer is None:
+            proposer = DraftProposer(draft, k) if draft is not None \
+                else NGramProposer(k)
+        else:
+            # the proposer's draft width IS the verify shape
+            k = int(getattr(proposer, "k", k))
+        hist = [list(tokens[i, :lens_h[i]].astype(np.int64))
+                for i in range(b)]
+        first = np.asarray(state.tok)[:, 0]
+        rows = [[int(t)] for t in first]
+        for i in range(b):
+            hist[i].append(int(first[i]))
+        if getattr(proposer, "needs_prefill", False):
+            key, sub = jax.random.split(key)
+            proposer.start(tokens, prompt_len, sub)
+
+        done = np.array([eos_id is not None and rows[i][-1] == eos_id
+                         for i in range(b)])
+        # the verify window must not wrap the target ring; a draft model
+        # appends k entries to its OWN ring too (proposer.cache_len)
+        limit = self._cache_len
+        if getattr(proposer, "cache_len", None):
+            limit = min(limit, proposer.cache_len + 1)
+        while True:
+            live = [i for i in range(b) if len(rows[i]) < max_new_tokens
+                    and not done[i]]
+            if not live:
+                break
+            key, sub = jax.random.split(key)
+            if max(lens_h[i] for i in live) + k + 1 <= limit:
+                draft_toks, draft_probs = proposer.propose(
+                    hist, state, lens_h, sub)
+                key, sub = jax.random.split(key)
+                state, out, counts = self.verify_step(
+                    state, draft_toks, draft_probs, sub)
+                out_h = np.asarray(out)
+                counts_h = np.asarray(counts)
+            else:
+                state, _ = self.step(state, sub)
+                out_h = np.asarray(state.tok)
+                counts_h = np.ones(b, np.int64)
+            lens_h += counts_h
+            for i in range(b):
+                emitted = [int(t) for t in out_h[i, :counts_h[i]]]
+                # history tracks everything COMMITTED to the cache —
+                # including any window tail past an EOS
+                hist[i].extend(emitted)
+                if i in live:
+                    if eos_id is not None and eos_id in emitted:
+                        # discard the speculation-window tail after EOS
+                        # (same rule as DecodeServer's deliver)
+                        emitted = emitted[:emitted.index(eos_id) + 1]
+                        done[i] = True
+                    rows[i].extend(emitted)
+        n = min(max_new_tokens, max(len(r) for r in rows))
+        out = np.zeros((b, n), np.int32)
+        for i in range(b):
+            row = (rows[i] + [rows[i][-1]] * n)[:n]
+            out[i] = row
+        return out
 
     def generate(self, tokens, prompt_len=None, max_new_tokens=16,
                  seed=0, eos_id=None):
@@ -462,11 +726,41 @@ class DecodePredictor:
         finally:
             self._probing = False
 
+    def cache_bytes(self, state):
+        """Static byte size of the ring caches behind ``state`` — data
+        AND scale planes — sized through the analysis width table
+        (``analysis.hlo_parse.shape_bytes``, f8/sub-byte aware), so the
+        number mxlint budgets and the bench's tokens/s/GB headline share
+        one accounting."""
+        import jax.tree_util as jtu
+
+        from .analysis.hlo_parse import shape_bytes, shape_str
+
+        return sum(shape_bytes(shape_str(leaf.shape, leaf.dtype))
+                   for leaf in jtu.tree_leaves(state.caches))
+
+    def _cache_meta(self, state):
+        """Cache metadata for artifacts: the static byte budget plus the
+        DATA dtypes actually stored (the cache-bytes pass flags an f32
+        data plane inside a quantized config from these)."""
+        from .ops.attention import QuantKV
+
+        dtypes = set()
+        for kc, vc in state.caches:
+            for c in (kc, vc):
+                dtypes.add(str((c.data if isinstance(c, QuantKV)
+                                else c).dtype))
+        return {"cache_bytes": self.cache_bytes(state),
+                "kv_dtype": str(self._kv_dtype)
+                if self._kv_dtype is not None else None,
+                "cache_data_dtypes": sorted(dtypes)}
+
     def decode_artifact(self, state, key=None, name="decode_step"):
         """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
         donated decode-step program at this state's shapes — the "zero
         retraces / zero allocation per token" serving claims as checkable
-        metadata (donated leaves = every cache/len/token buffer)."""
+        metadata (donated leaves = every cache/len/token buffer; cache
+        byte + dtype meta for the cache-bytes pass)."""
         import jax.tree_util as jtu
 
         from .analysis.artifact import artifact_from_jit, aval_of as _aval
@@ -484,9 +778,257 @@ class DecodePredictor:
                 mesh_shape=dict(self._mesh.shape)
                 if self._mesh is not None else None,
                 trace_count=count, expected_traces=1,
-                cache_len=self._cache_len)
+                cache_len=self._cache_len, **self._cache_meta(state))
         finally:
             self._probing = False
+
+    def verify_artifact(self, state, k, draft_probs=None, key=None,
+                        name="verify_step"):
+        """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
+        donated speculative-verify program at this state's shapes and
+        draft width ``k`` — same donation/retrace/cache-byte contract as
+        the decode step (expected traces = one per driven (B, k, has-q)
+        signature).  ``draft_probs`` (array or aval) selects the
+        with-proposal-distribution variant; ``None`` the deterministic-
+        proposer one."""
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+
+        from .analysis.artifact import artifact_from_jit, aval_of as _aval
+
+        import jax
+
+        env = {n: _aval(v) for n, v in self._env.items()}
+        astate = jtu.tree_map(_aval, state)
+        b = state.lens.shape[0]
+        atoks = jax.ShapeDtypeStruct((b, int(k)), jnp.int32)
+        aq = _aval(draft_probs) if draft_probs is not None else None
+        akey = _aval(key if key is not None else self._zero_key)
+        donated = len(jtu.tree_leaves(astate)) if self._donate else 0
+        count = self.trace_counts["verify"]
+        expected = max(len(self._verify_shapes), 1)
+        self._probing = True
+        try:
+            return artifact_from_jit(
+                self._verify_fn, (env, astate, atoks, aq, akey), name=name,
+                donated_leaves=donated,
+                mesh_shape=dict(self._mesh.shape)
+                if self._mesh is not None else None,
+                trace_count=count, expected_traces=expected,
+                cache_len=self._cache_len, spec_k=int(k),
+                **self._cache_meta(state))
+        finally:
+            self._probing = False
+
+
+def _build_insert_fn():
+    """Jitted splice of a batch-1 :class:`DecodeState` into slot ``slot``
+    of a batch state (traced slot index — admission never retraces).
+    Generic over the cache pytree, so quantized caches (data + scale
+    leaves) and draft-model states ride the same machinery."""
+    import jax
+
+    from . import config as _config
+
+    donate = (0,) if _config.get("MXNET_DECODE_DONATE") else ()
+
+    def insert(state, one, slot):
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+
+        slot = jnp.asarray(slot, jnp.int32)
+
+        def put(full, single):
+            idx = (slot,) + (jnp.int32(0),) * (full.ndim - 1)
+            return jax.lax.dynamic_update_slice(full, single, idx)
+
+        return jtu.tree_map(put, state, one)
+
+    return jax.jit(insert, donate_argnums=donate)
+
+
+def _empty_batch_state(one, slots):
+    """An all-zero batch state with ``slots`` rows shaped like the
+    batch-1 state ``one``."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(
+        lambda x: jnp.zeros((slots,) + tuple(x.shape[1:]), x.dtype), one)
+
+
+class NGramProposer:
+    """Model-free draft proposer: n-gram lookup over each sequence's own
+    history (prompt-lookup / self-speculation).
+
+    Matches the last ``ngram`` committed tokens (``MXNET_SPEC_NGRAM``)
+    against earlier history and proposes the k tokens that followed the
+    most recent earlier occurrence, backing off to shorter suffixes and
+    finally to repeating the last token — always exactly k proposals, so
+    the verify shape stays fixed.  Deterministic, so its proposal
+    distribution is a delta and :func:`ops.sample.speculative_accept`
+    needs no q vectors (``draft_probs=None``).  Pure host-side numpy: the
+    proposer costs no device program at all, which is what makes
+    self-speculation profitable even at high rejection rates.
+    """
+
+    cache_len = None      # no draft ring to keep inside
+    needs_prefill = False
+
+    def __init__(self, k, ngram=None):
+        from . import config as _config
+
+        self.k = int(k)
+        if self.k <= 0:
+            raise MXNetError("NGramProposer k must be positive")
+        self.ngram = int(ngram) if ngram is not None \
+            else int(_config.get("MXNET_SPEC_NGRAM"))
+        self.ngram = max(1, self.ngram)
+
+    def propose(self, histories, state=None, lens=None, key=None):
+        out = np.zeros((len(histories), self.k), np.int32)
+        for r, h in enumerate(histories):
+            out[r] = self._row(np.asarray(h, np.int64).reshape(-1))
+        return out, None
+
+    def _row(self, h):
+        k = self.k
+        if h.size == 0:
+            return np.zeros(k, np.int32)
+        for n in range(min(self.ngram, h.size - 1), 0, -1):
+            # vectorized suffix match over every window start with a
+            # continuation (body drops the last element, so i + n < |h|
+            # holds for free and the suffix's own occurrence is excluded)
+            body = h[:-1]
+            if body.size < n:
+                continue
+            win = np.lib.stride_tricks.sliding_window_view(body, n)
+            hits = np.flatnonzero((win == h[-n:]).all(axis=1))
+            if hits.size:
+                i = int(hits[-1])            # most recent earlier match
+                cont = h[i + n:i + n + k]
+                pad = np.full(k - cont.size, cont[-1], np.int64)
+                return np.concatenate([cont, pad]).astype(np.int32)
+        return np.full(k, h[-1], np.int32)
+
+
+class DraftProposer:
+    """Draft-model proposer: k autoregressive steps of a SMALL
+    :class:`DecodePredictor` over the same vocabulary.
+
+    The draft keeps its own ring caches in lockstep with the target's
+    committed prefix: each macro-step it resumes from the target's
+    (lens, tok) — rejection rollback is free, rejected draft cache
+    entries sit past ``lens`` where the length mask hides them until the
+    next append overwrites them.  Committed tokens the draft never
+    stepped through (the k-th draft of a fully-accepted window; tokens
+    decoded by plain near-wrap fallback steps) are healed by a
+    teacher-forced CATCH-UP at the top of :meth:`propose`: per-row
+    ``filled`` counters (host-side, fed by the caller's committed-token
+    histories — no extra device sync) replay the missing inputs through
+    the same decode-step program, so the draft cache never holds a
+    permanent hole and acceptance does not decay over long serves.  A
+    greedy draft proposes deterministically (``draft_probs=None``, delta
+    proposals); a stochastic draft returns its exact per-step sampling
+    distributions so the acceptance ratio p/q and the residual are
+    well-defined.  One decode-step program on the draft, traced once —
+    the "draft" program mxlint audits.
+    """
+
+    needs_prefill = True
+
+    def __init__(self, predictor, k):
+        self._pred = predictor
+        self.k = int(k)
+        if self.k <= 0:
+            raise MXNetError("DraftProposer k must be positive")
+        self.cache_len = predictor.cache_len
+        self._state = None
+        self._insert = None
+        self._filled = None     # (B,) host int64: cache valid through
+
+    @property
+    def predictor(self):
+        return self._pred
+
+    def start(self, tokens, prompt_len, key=None):
+        """Prefill the draft on the same (B, P) prompt batch (the
+        fixed-batch :meth:`DecodePredictor.generate_speculative` path)."""
+        self._state, _ = self._pred.prefill(tokens, prompt_len, key)
+        b = self._state.lens.shape[0]
+        self._filled = np.broadcast_to(
+            np.asarray(prompt_len, np.int64).reshape(-1), (b,)).copy()
+
+    def admit(self, tokens, prompt_len, slot, slots, key=None):
+        """Prefill ONE request and splice it into draft slot ``slot`` —
+        the serving-loop path (mirrors the server's own admission)."""
+        one, _ = self._pred.prefill(tokens, prompt_len, key)
+        if self._state is None:
+            self._state = _empty_batch_state(one, slots)
+            self._filled = np.zeros(slots, np.int64)
+        if self._insert is None:
+            self._insert = _build_insert_fn()
+        self._state = self._insert(self._state, one, np.int32(slot))
+        self._filled[slot] = int(prompt_len)
+
+    def _hist_tok(self, histories, pos):
+        """(B, 1) int32 of each row's committed token at ``pos`` (host;
+        clamped — rows past their history just replay their last
+        token, which only touches already-dead cache slots)."""
+        out = np.zeros((len(histories), 1), np.int32)
+        for r, h in enumerate(histories):
+            out[r, 0] = int(h[min(int(pos[r]), len(h) - 1)])
+        return out
+
+    def propose(self, histories, state, lens, key=None):
+        """Teacher-forced catch-up to the target's committed prefix,
+        then k draft steps; returns ``(draft_toks (B, k), draft_probs
+        (B, k, V) | None)``.  ``lens`` is the caller's HOST-side
+        committed-length vector (the serving loops already track it)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._state is None:
+            raise MXNetError("DraftProposer.propose before start()/admit()")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        lens_h = np.broadcast_to(
+            np.asarray(lens, np.int64).reshape(-1),
+            (self._state.lens.shape[0],)).copy()
+
+        # --- catch-up: replay committed tokens the draft never saw
+        # (position `filled` onward) through the same step program.
+        # Rows already caught up harmlessly re-append their pending
+        # token at `lens` — the very slot the proposal steps below
+        # overwrite first.  Usual gap is 0 or 1 (the k-th draft of a
+        # fully-accepted window); fallback eras pay theirs here too.
+        cur = np.minimum(self._filled, lens_h)
+        st = self._state
+        for _ in range(int((lens_h - cur).max()) if cur.size else 0):
+            st = DecodeState(st.caches, jnp.asarray(cur, jnp.int32),
+                             jnp.asarray(self._hist_tok(histories, cur)))
+            key, sub = jax.random.split(key)
+            st, _ = self._pred.step(st, sub)
+            cur = np.minimum(cur + 1, lens_h)
+
+        # --- k proposal steps from the target's committed (lens, tok).
+        # Fresh copies: the draft step DONATES its state, and lens/tok
+        # here are the target's live buffers.
+        st = DecodeState(st.caches, state.lens + 0, state.tok + 0)
+        toks, qs = [], []
+        for _ in range(self.k):
+            key, sub = jax.random.split(key)
+            st, probs = self._pred.step(st, sub)
+            # st.tok is donated into the NEXT draft step — keep a copy
+            toks.append(st.tok + 0)
+            if not self._pred._greedy:
+                qs.append(self._pred._policy_probs(probs))
+        self._state = st
+        # appended inputs were [tok, d_1..d_{k-1}]: valid through the
+        # accepted prefix, which the caller's next `lens` reveals
+        self._filled = lens_h + self.k
+        return (jnp.concatenate(toks, axis=1),
+                jnp.stack(qs, axis=1) if qs else None)
 
 
 class DecodeServer:
@@ -503,7 +1045,8 @@ class DecodeServer:
     """
 
     def __init__(self, predictor, max_prefill, slots=None, eos_id=None,
-                 max_new_tokens=None, seed=0):
+                 max_new_tokens=None, seed=0, spec_k=None, proposer=None,
+                 draft=None):
         from . import config as _config
 
         self._pred = predictor
@@ -520,8 +1063,34 @@ class DecodeServer:
         self._queue = deque()
         self._next_id = 0
         self._insert_fn = None
-        self.steps = 0          # decode steps executed (bench accounting)
+        # --- speculative decoding (MXNET_SPEC_K / explicit args) ---
+        if spec_k is None:
+            spec_k = int(_config.get("MXNET_SPEC_K"))
+        if proposer is not None:
+            spec_k = int(getattr(proposer, "k", spec_k))
+        elif draft is not None:
+            spec_k = int(spec_k) or 4
+            proposer = DraftProposer(draft, spec_k)
+        elif spec_k:
+            proposer = NGramProposer(spec_k)
+        self._spec_k = int(spec_k or 0)
+        self._proposer = proposer
+        if proposer is not None and getattr(proposer, "cache_len", None):
+            if self._max_prefill > proposer.cache_len:
+                raise MXNetError(
+                    "max_prefill %d exceeds the draft's cache_len %d"
+                    % (self._max_prefill, proposer.cache_len))
+        self.steps = 0          # device steps executed (bench accounting)
+        self.spec_steps = 0     # of which speculative verify steps
         self.tokens_out = 0     # tokens delivered to finished requests
+        self.proposed = 0       # drafted tokens offered to verify
+        self.accepted = 0       # drafted tokens accepted
+
+    @property
+    def accept_rate(self):
+        """Fraction of drafted tokens the target accepted (the k-tuning
+        signal: tokens/step = 1 + accept_rate * k on average)."""
+        return self.accepted / max(self.proposed, 1)
 
     def submit(self, tokens, max_new_tokens=None):
         """Queue a prompt (1-D int sequence); returns the request id."""
@@ -536,50 +1105,35 @@ class DecodeServer:
         self._queue.append((rid, tokens, cap))
         return rid
 
-    # ------------------------------------------------------------------
-    def _build_insert(self):
-        import jax
-
-        from . import config as _config
-
-        donate = (0,) if _config.get("MXNET_DECODE_DONATE") else ()
-
-        def insert(state, one, slot):
-            import jax.numpy as jnp
-
-            slot = jnp.asarray(slot, jnp.int32)
-            zero = jnp.zeros((), jnp.int32)
-            caches = tuple(
-                (jax.lax.dynamic_update_slice(kc, nk, (slot, zero, zero)),
-                 jax.lax.dynamic_update_slice(vc, nv, (slot, zero, zero)))
-                for (kc, vc), (nk, nv) in zip(state.caches, one.caches))
-            lens = jax.lax.dynamic_update_slice(state.lens, one.lens,
-                                                (slot,))
-            tok = jax.lax.dynamic_update_slice(state.tok, one.tok,
-                                               (slot, zero))
-            return DecodeState(caches, lens, tok)
-
-        return jax.jit(insert, donate_argnums=donate)
-
-    def _empty_batch_state(self, one):
-        import jax.numpy as jnp
-        import jax.tree_util as jtu
-
-        b = self._slots
-        return jtu.tree_map(
-            lambda x: jnp.zeros((b,) + tuple(x.shape[1:]), x.dtype), one)
-
     def run(self):
         """Drain the queue; returns ``{request_id: np.int32 array}`` of
-        generated tokens (EOS included when hit)."""
+        generated tokens (EOS included when hit).
+
+        With speculation armed (``spec_k``/``MXNET_SPEC_K``/``proposer``/
+        ``draft``), each iteration drafts k tokens per slot and commits
+        1..k+1 through ONE verify pass; a sequence that emits EOS or hits
+        its cap MID-WINDOW retires immediately — the window's later
+        tokens are discarded from the result (their cache entries are
+        dead weight the next admission overwrites) and the freed slot
+        refills before the next step.  Near the ring-wrap boundary the
+        loop falls back to plain single-token steps (both programs
+        already traced — still zero retraces).
+        """
         import jax
 
         key = jax.random.PRNGKey(self._seed)
         state = None
         active = {}     # slot -> [rid, tokens list, max_new]
         results = {}
+        histories = {}  # slot -> committed token list (proposer food)
+        slot_lens = np.zeros(self._slots, np.int64)
+        proposer = self._proposer
+        k = self._spec_k
+        limit = self._pred.cache_len
+        if proposer is not None and getattr(proposer, "cache_len", None):
+            limit = min(limit, proposer.cache_len + 1)
         if self._insert_fn is None:
-            self._insert_fn = self._build_insert()
+            self._insert_fn = _build_insert_fn()
 
         def retire():
             for slot in list(active):
@@ -591,6 +1145,17 @@ class DecodeServer:
                     self.tokens_out += len(toks)
                     del active[slot]
 
+        def deliver(rec, emitted):
+            """Append a window of emitted tokens to a request, honoring
+            its cap and retiring at an EOS inside the window."""
+            _, toks, max_new = rec
+            for t in emitted:
+                if len(toks) >= max_new:
+                    break
+                toks.append(int(t))
+                if self._eos_id is not None and t == self._eos_id:
+                    break
+
         while self._queue or active:
             # admit: prefill one request per free slot, splice into batch
             while self._queue and len(active) < self._slots:
@@ -599,21 +1164,51 @@ class DecodeServer:
                 padded[0, :prompt.size] = prompt
                 key, sub = jax.random.split(key)
                 one, _ = self._pred.prefill(padded, prompt.size, sub)
-                if state is None:
-                    state = self._empty_batch_state(one)
                 slot = next(s for s in range(self._slots)
                             if s not in active)
+                if state is None:
+                    state = _empty_batch_state(one, self._slots)
                 first = int(np.asarray(one.tok)[0, 0])
                 state = self._insert_fn(state, one, np.int32(slot))
+                if proposer is not None \
+                        and getattr(proposer, "needs_prefill", False):
+                    key, sub = jax.random.split(key)
+                    proposer.admit(padded, prompt.size, slot, self._slots,
+                                   sub)
                 active[slot] = [rid, [first], max_new]
+                histories[slot] = list(prompt.astype(np.int64)) + [first]
+                slot_lens[slot] = prompt.size
             retire()
             if not active:
                 continue
             key, sub = jax.random.split(key)
-            state, _ = self._pred.step(state, sub)
-            self.steps += 1
-            toks = np.asarray(state.tok)[:, 0]
-            for slot, rec in active.items():
-                rec[1].append(int(toks[slot]))
+            can_spec = proposer is not None and k > 0 and \
+                max(slot_lens[s] for s in active) + k + 1 <= limit
+            if can_spec:
+                hists = [histories.get(s) or [0] for s in range(self._slots)]
+                draft_toks, draft_probs = proposer.propose(
+                    hists, state, slot_lens, sub)
+                key, sub = jax.random.split(key)
+                state, out, counts = self._pred.verify_step(
+                    state, draft_toks, draft_probs, sub)
+                out_h = np.asarray(out)
+                counts_h = np.asarray(counts).astype(np.int64)
+                self.steps += 1
+                self.spec_steps += 1
+                for slot, rec in active.items():
+                    emitted = out_h[slot, :counts_h[slot]]
+                    self.proposed += k
+                    self.accepted += int(counts_h[slot]) - 1
+                    deliver(rec, emitted)
+                    histories[slot].extend(int(t) for t in emitted)
+                slot_lens += counts_h
+            else:
+                state, _ = self._pred.step(state, sub)
+                self.steps += 1
+                toks = np.asarray(state.tok)[:, 0]
+                for slot, rec in active.items():
+                    deliver(rec, toks[slot:slot + 1])
+                    histories[slot].append(int(toks[slot]))
+                slot_lens += 1
             retire()
         return results

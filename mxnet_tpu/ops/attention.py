@@ -17,6 +17,8 @@ mesh axes:
 """
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import numpy as np
 
 from ..attrs import Param, ParamSchema
@@ -70,7 +72,77 @@ def sdpa(q, k, v, num_heads=1, causal=False, scale=None):
 # head group's cache slice.
 # ---------------------------------------------------------------------------
 
-def cache_append(cache, new, start_pos):
+class QuantKV(NamedTuple):
+    """A quantized ring-buffer cache: narrow ``data`` plus per-(token,
+    head) fp32 ``scale``.
+
+    ``data`` is the (B, C, E) K or V buffer in the narrow storage dtype
+    (int8 / fp8); ``scale`` is (B, C, H) float32 — one scale per cache
+    slot per head, chosen at append time so each head's hd-wide slice
+    fills the storage dtype's representable range.  A jax pytree (both
+    leaves donate/shard independently: ``data`` follows
+    ``tp_rules.kv_cache_pspec``; ``scale``'s trailing head dim shards the
+    same way, an H-split IS the same head-group split).
+    """
+
+    data: object
+    scale: object
+
+
+# quantization range per storage dtype: int8 is symmetric round-to-nearest
+# in [-127, 127]; the fp8 variants scale into their finite max so the cast
+# never saturates (values are <= qmax by construction)
+_KV_QMAX = {"int8": 127.0, "float8_e4m3fn": 448.0, "float8_e5m2": 57344.0}
+
+
+def kv_qmax(dtype):
+    """Quantization range of a KV storage dtype (KeyError = unsupported —
+    the MXNET_KV_DTYPE consumer turns that into a config error)."""
+    return _KV_QMAX[np.dtype(dtype).name]
+
+
+def quantize_kv(x, dtype, num_heads=1):
+    """(B, t, E) float K/V -> :class:`QuantKV` with per-(token, head)
+    scales: ``scale = amax_head / qmax``, ``data = round(x / scale)``
+    (int8) or a saturating-range fp8 cast.  All-zero heads (pad slots)
+    quantize to zeros under a floor scale instead of dividing by zero."""
+    import jax.numpy as jnp
+
+    b, t, e = x.shape
+    assert e % num_heads == 0, "embed dim not divisible by num_heads"
+    qmax = kv_qmax(dtype)
+    xh = x.astype(jnp.float32).reshape(b, t, num_heads, e // num_heads)
+    amax = jnp.max(jnp.abs(xh), axis=-1)                      # (B, t, H)
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    q = xh / scale[..., None]
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    data = q.astype(dtype).reshape(b, t, e)
+    return QuantKV(data, scale)
+
+
+def dequantize_kv(cache, num_heads=None, out_dtype=None):
+    """:class:`QuantKV` -> the float (B, C, E) buffer the kernels attend
+    against (``data * scale`` per head).  Plain arrays pass through, so
+    callers handle both cache layouts with one code path.  The head
+    count is authoritative in the scale plane's trailing dim;
+    ``num_heads``, when given, must agree (a cache built under a
+    different head config must fail loudly, not descale wrongly)."""
+    import jax.numpy as jnp
+
+    if not isinstance(cache, QuantKV):
+        return cache
+    b, c, e = cache.data.shape
+    h = cache.scale.shape[-1]
+    assert num_heads is None or num_heads == h, \
+        "cache quantized with %d heads, caller expects %d" % (h, num_heads)
+    x = cache.data.astype(jnp.float32).reshape(b, c, h, e // h) \
+        * cache.scale[..., None]
+    x = x.reshape(b, c, e)
+    return x.astype(out_dtype) if out_dtype is not None else x
+
+
+def cache_append(cache, new, start_pos, num_heads=1):
     """Write ``new`` (B, t, E) into ring-buffer slots [start_pos,
     start_pos+t) mod C of ``cache`` (B, C, E).
 
@@ -78,24 +150,37 @@ def cache_append(cache, new, start_pos):
     or a per-sequence (B,) vector (batched serving: each slot at its own
     length).  The t == 1 decode hot path is a per-row
     ``jax.lax.dynamic_update_slice`` (never wraps: one slot always fits);
-    multi-position appends scatter, wrapping modulo C so the cache keeps
-    the latest C tokens (sliding-window semantics — attention over a set
-    of keys is order-agnostic, positions having been added at the input
-    embedding).  Traceable; donated-safe (pure functional update).
+    multi-position appends (the speculative verify pass's fixed-width
+    k+1-token append) scatter, wrapping modulo C so the cache keeps the
+    latest C tokens (sliding-window semantics — attention over a set of
+    keys is order-agnostic, positions having been added at the input
+    embedding).  Rejected speculative entries are not un-written: the
+    caller rolls back ``lens`` instead, the length mask hides them, and
+    the next append overwrites them in place.
+
+    A :class:`QuantKV` cache quantizes ``new`` on the way in
+    (per-(token, head) scales — pass ``num_heads``); both its leaves
+    update at the same slots.  Traceable; donated-safe (pure functional
+    update).
     """
     import jax
     import jax.numpy as jnp
 
-    b, t, _ = new.shape
+    if isinstance(cache, QuantKV):
+        qnew = quantize_kv(new, cache.data.dtype, num_heads)
+        return QuantKV(cache_append(cache.data, qnew.data, start_pos),
+                       cache_append(cache.scale, qnew.scale, start_pos))
+    b, t = new.shape[0], new.shape[1]
     c = cache.shape[1]
     start = jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32).reshape(-1),
                              (b,))
     new = new.astype(cache.dtype)
     if t == 1:
         slot = start % c
+        zero = (jnp.int32(0),) * (new.ndim - 2)
         return jax.vmap(
             lambda buf, row, s: jax.lax.dynamic_update_slice(
-                buf, row, (s, jnp.int32(0))))(cache, new, slot)
+                buf, row, (s,) + zero))(cache, new, slot)
     if t > c:
         # only the latest C tokens can land; trimming BEFORE the scatter
         # keeps the slot indices unique per row (scatter order with
@@ -107,20 +192,16 @@ def cache_append(cache, new, start_pos):
     return cache.at[jnp.arange(b)[:, None], pos].set(new)
 
 
-def sdpa_decode(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
-    """Attend query position(s) against a ring-buffer KV cache.
-
-    (B, tq, E) queries over (B, C, E)/(B, C, Ev) caches -> (B, tq, Ev).
-    ``total_len`` — scalar or (B,) — counts tokens appended to the cache
-    INCLUDING the query position(s): query i (the token at global position
-    ``total_len - tq + i``) sees cache slots j < min(total_len - tq + 1 + i,
-    C); once the ring has wrapped every slot holds a live token and the
-    window is all C slots.  Same fp32-softmax numerics as :func:`sdpa`, so
-    prefill+decode logits match the full forward pass.  With tq > 1 the
-    caller must not have wrapped past its own queries (t <= C).
-    """
+def _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale):
+    """Shared length-masked cache-attention core behind
+    :func:`sdpa_decode` (tq == 1) and :func:`sdpa_verify` (tq == k+1).
+    Quantized caches (:class:`QuantKV`) dequantize here, per head, before
+    the score matmul — the logits are bit-identical to attending the
+    dequantized buffers densely, which is what the parity tests pin."""
     import jax.numpy as jnp
 
+    k_cache = dequantize_kv(k_cache, num_heads)
+    v_cache = dequantize_kv(v_cache, num_heads)
     b, tq, e = q.shape
     c = k_cache.shape[1]
     ev = v_cache.shape[2]
@@ -142,6 +223,42 @@ def sdpa_decode(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
     p = p / jnp.sum(p, axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bkhe->bqhe", p.astype(vh.dtype), vh)
     return out.reshape(b, tq, ev)
+
+
+def sdpa_decode(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
+    """Attend query position(s) against a ring-buffer KV cache.
+
+    (B, tq, E) queries over (B, C, E)/(B, C, Ev) caches -> (B, tq, Ev).
+    ``total_len`` — scalar or (B,) — counts tokens appended to the cache
+    INCLUDING the query position(s): query i (the token at global position
+    ``total_len - tq + i``) sees cache slots j < min(total_len - tq + 1 + i,
+    C); once the ring has wrapped every slot holds a live token and the
+    window is all C slots.  Same fp32-softmax numerics as :func:`sdpa`, so
+    prefill+decode logits match the full forward pass.  Caches may be
+    :class:`QuantKV` (dequantized per head inside the kernel).  With
+    tq > 1 the caller must not have wrapped past its own queries
+    (total <= C) — that multi-position form is :func:`sdpa_verify`.
+    """
+    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale)
+
+
+def sdpa_verify(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
+    """Length-masked multi-position cache attention — the speculative
+    verify kernel.
+
+    The target model scores all k+1 speculative positions in ONE pass:
+    ``q`` is (B, k+1, E) (last committed token + k drafts), the caches
+    already hold their K/V (``cache_append`` fixed-width append), and
+    ``total_len`` counts through the last draft.  Query i masks to slots
+    ``j < min(total_len - k + i, C)`` — itself and everything before it,
+    never a later draft — so the k+1 output rows each equal what a
+    sequential :func:`sdpa_decode` chain would have produced (the
+    acceptance rule compares them against the proposal distribution).
+    Requires the verify window not to wrap (``total_len <= C``); the
+    decode layer gates speculation off near the ring boundary and falls
+    back to single-token steps, keeping every shape static.
+    """
+    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale)
 
 
 # Which path the last dot_product_attention dispatch traced: "flash" or

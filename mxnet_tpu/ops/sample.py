@@ -19,28 +19,160 @@ def _shape_dtype(attrs, jnp):
     return shape, (jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt))
 
 
-def sample_tokens(key, logits, temperature=1.0, top_k=0):
-    """Draw token ids from ``(..., V)`` logits (or log-probabilities).
+def is_greedy_policy(temperature, top_k):
+    """``temperature == 0`` and ``top_k == 1`` are both deterministic
+    argmax — THE greedy predicate, shared by the sampler and the
+    speculative verifier so they can never disagree."""
+    return temperature == 0 or top_k == 1
 
-    The decode-loop sampler (`mxnet_tpu.decode`): ``temperature == 0`` is
-    greedy argmax (``key`` unused — fully deterministic); otherwise logits
-    scale by ``1/temperature``, optionally truncate to the ``top_k``
-    largest (top-k sampling), and draw via ``jax.random.categorical``.
-    Traceable, so the whole sampler bakes into the jitted decode-step
-    program; determinism under a fixed PRNGKey comes from jax's counter-
-    based RNG.  Returns int32 ids with the leading logits dims.
-    """
+
+def policy_logits(logits, temperature=1.0, top_k=0):
+    """The scaled / top-k-truncated logits the sampling policy draws
+    from.  Single source of truth for the policy transformation:
+    :func:`sample_tokens` feeds these to ``jax.random.categorical`` and
+    the speculative verifier (``decode._policy_probs``) softmaxes the
+    SAME values into explicit probability vectors — the
+    distribution-preservation guarantee rests on the two never
+    diverging, so there is exactly one implementation."""
     import jax
     import jax.numpy as jnp
 
-    if temperature == 0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits.astype(jnp.float32) / float(temperature)
     if top_k and 0 < top_k < logits.shape[-1]:
         vals = jax.lax.top_k(scaled, top_k)[0]
         kth = vals[..., -1:]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return scaled
+
+
+def sample_tokens(key, logits, temperature=1.0, top_k=0):
+    """Draw token ids from ``(..., V)`` logits (or log-probabilities).
+
+    The decode-loop sampler (`mxnet_tpu.decode`): ``temperature == 0`` OR
+    ``top_k == 1`` is greedy — a pure argmax, no PRNG fold-in, no
+    ``jax.random.categorical`` on the per-token hot path (``key`` unused —
+    fully deterministic, bit-identical across keys).  Otherwise draw via
+    ``jax.random.categorical`` over :func:`policy_logits`.  Traceable, so
+    the whole sampler bakes into the jitted decode-step program;
+    determinism under a fixed PRNGKey comes from jax's counter-based RNG.
+    Returns int32 ids with the leading logits dims.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if is_greedy_policy(temperature, top_k):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, policy_logits(logits, temperature, top_k),
+        axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Speculative sampling (Leviathan et al., "Fast Inference from Transformers
+# via Speculative Decoding"): a draft proposes k tokens, the target scores
+# all k+1 positions in one verify pass, and the acceptance-rejection rule
+# below keeps the OUTPUT distribution exactly the target's.  Pure jnp — it
+# bakes into the jitted verify program (mxnet_tpu.decode).
+# ---------------------------------------------------------------------------
+
+def residual_probs(p, q):
+    """The rejection-resample distribution ``norm(max(p - q, 0))``.
+
+    ``p``/``q`` are (..., V) probability vectors (target and proposal at
+    the first rejected position).  The identity that makes speculative
+    sampling exact:  ``q(v) * min(1, p(v)/q(v)) + P(reject) * res(v) =
+    p(v)`` with ``P(reject) = 1 - sum_u q(u) min(1, p(u)/q(u))`` — pinned
+    by tests/test_decode.py.  Degenerate ``p <= q`` everywhere (reject
+    probability zero, the branch is never taken) falls back to ``p`` so
+    the program stays NaN-free.
+    """
+    import jax.numpy as jnp
+
+    res = jnp.maximum(p.astype(jnp.float32) - q.astype(jnp.float32), 0.0)
+    tot = jnp.sum(res, axis=-1, keepdims=True)
+    return jnp.where(tot > 0, res / jnp.where(tot > 0, tot, 1.0), p)
+
+
+def speculative_accept(key, target_probs, draft_toks, draft_probs=None,
+                       greedy=False):
+    """Accept a prefix of k drafted tokens against k+1 target
+    distributions; resample at the first mismatch.
+
+    Parameters
+    ----------
+    key
+        PRNG key (unused when ``greedy``).
+    target_probs : (B, k+1, V)
+        The target model's sampling distributions at the k+1 verify
+        positions: row i is ``p(. | prefix, d_1..d_i)`` (row 0
+        conditions on the last committed token only; row k is the bonus
+        distribution after all k drafts).
+    draft_toks : (B, k) int32
+        The proposed tokens ``d_1..d_k``.
+    draft_probs : (B, k, V) or None
+        The proposal distributions the drafts were DRAWN from.  ``None``
+        means a deterministic proposer (n-gram lookup, greedy draft):
+        ``q_i`` is a delta at ``d_i``, so acceptance is ``u < p_i(d_i)``
+        and the residual is ``p_i`` with ``d_i`` zeroed, renormalized.
+    greedy : bool
+        Target samples by argmax: accept ``d_i`` iff it IS the argmax of
+        ``p_i``; the resampled/bonus token is an argmax too.  Output then
+        equals target-only greedy decoding token for token.
+
+    Returns ``(counts, out_toks)``: ``counts`` (B,) int32 in [1, k+1] —
+    accepted drafts + the one resampled/bonus token; ``out_toks``
+    (B, k+1) int32 — the emitted tokens, valid through ``counts`` (later
+    columns are garbage the caller must mask).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, kp1, v = target_probs.shape
+    k = kp1 - 1
+    p = target_probs.astype(jnp.float32)
+    toks = draft_toks.astype(jnp.int32)
+    rows = jnp.arange(b)
+
+    if greedy:
+        tgt = jnp.argmax(p, axis=-1).astype(jnp.int32)        # (B, k+1)
+        accept = toks == tgt[:, :k]                            # (B, k)
+    else:
+        p_at_d = jnp.take_along_axis(p[:, :k], toks[..., None],
+                                     axis=-1)[..., 0]          # (B, k)
+        if draft_probs is None:
+            ratio = p_at_d                                     # q = delta
+        else:
+            q_at_d = jnp.take_along_axis(
+                draft_probs.astype(jnp.float32), toks[..., None],
+                axis=-1)[..., 0]
+            ratio = p_at_d / jnp.maximum(q_at_d, 1e-30)
+        key, ukey = jax.random.split(key)
+        u = jax.random.uniform(ukey, (b, k))
+        accept = u < ratio                                     # min(1,.) free
+
+    # accepted prefix length a in [0, k]: drafts up to the first rejection
+    a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+    # the distribution the (a+1)-th emitted token comes from: p_{a+1} —
+    # which at a == k is already the bonus row — with the rejected
+    # draft's proposal mass removed when a < k
+    p_next = p[rows, a]                                        # (B, V)
+    if greedy:
+        next_tok = jnp.argmax(p_next, axis=-1).astype(jnp.int32)
+    else:
+        j = jnp.minimum(a, k - 1)
+        if draft_probs is None:
+            d_rej = toks[rows, j]
+            q_row = jax.nn.one_hot(d_rej, v, dtype=jnp.float32)
+        else:
+            q_row = draft_probs.astype(jnp.float32)[rows, j]
+        res = residual_probs(p_next, q_row)
+        dist = jnp.where((a == k)[:, None], p_next, res)
+        next_tok = jax.random.categorical(
+            key, jnp.log(dist + 1e-30), axis=-1).astype(jnp.int32)
+
+    out = jnp.concatenate([toks, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    out = out.at[rows, a].set(next_tok)
+    return (a + 1).astype(jnp.int32), out
 
 
 def register_all():

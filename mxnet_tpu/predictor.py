@@ -29,7 +29,8 @@ from . import context as ctx_mod
 from . import ndarray as nd
 from . import symbol as sym_mod
 
-__all__ = ["Predictor", "load_exported", "DecodePredictor", "DecodeServer"]
+__all__ = ["Predictor", "load_exported", "DecodePredictor",
+           "DecodeServer", "NGramProposer", "DraftProposer"]
 
 
 def _shape_key(input_shapes):
@@ -285,7 +286,8 @@ def load_exported(blob_or_path):
 # incremental decoding (prefill/decode split, KV caches, batched serving) —
 # re-exported here so the deployment surface is one import, mirroring how
 # the reference groups every predict entry point in c_predict_api.h
-from .decode import DecodePredictor, DecodeServer  # noqa: E402
+from .decode import (DecodePredictor, DecodeServer,  # noqa: E402
+                     DraftProposer, NGramProposer)
 
 
 def _as_param_dicts(params):

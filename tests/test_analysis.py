@@ -262,7 +262,7 @@ def test_decode_predictor_trace_counters():
     for _ in range(3):
         state, _ = pred.step(state, key)
     art = pred.decode_artifact(state)
-    assert pred.trace_counts == {"prefill": 1, "decode": 1}
+    assert pred.trace_counts == {"prefill": 1, "decode": 1, "verify": 0}
     assert art.trace_count == 1 and art.donated_leaves == \
         len(jax.tree_util.tree_leaves(state))
     rep = run_passes([art, pred.prefill_artifact(2, 8)],
@@ -426,8 +426,71 @@ def test_load_budgets_default_and_missing(tmp_path):
     assert "programs" in budgets          # the committed file
     assert set(budgets["programs"]) >= {"train_step", "eval_step",
                                         "prefill", "decode_step",
-                                        "ring_tp_step"}
+                                        "decode_step_q", "draft_step",
+                                        "verify_step", "ring_tp_step"}
     assert analysis.load_budgets(str(tmp_path / "nope.json")) == {}
+
+
+# ---------------------------------------------------------------------------
+# cache-bytes pass (PR 6): byte ceilings + quantized-config dtype check
+# ---------------------------------------------------------------------------
+def _cache_budgets(name, ceiling):
+    return {"programs": {name: {"cache_bytes": ceiling}}}
+
+
+def test_cache_bytes_pass_skips_programs_without_cache_meta():
+    from mxnet_tpu.analysis.passes import CacheBytesPass
+
+    rep = run_passes([_stub("train_step")], passes=[CacheBytesPass()])
+    assert [f.code for f in rep.findings] == ["no-cache"]
+    assert not rep.unsuppressed
+
+
+def test_cache_bytes_pass_flags_over_budget():
+    from mxnet_tpu.analysis.passes import CacheBytesPass
+
+    art = _stub("decode_step", meta={"cache_bytes": 4096,
+                                     "kv_dtype": None,
+                                     "cache_data_dtypes": ["float32"]})
+    rep = run_passes([art], passes=[CacheBytesPass()],
+                     budgets=_cache_budgets("decode_step", 2048))
+    assert len(rep.errors) == 1 and rep.errors[0].code == "over-budget"
+    # inclusive ceiling: measured == budget passes
+    rep = run_passes([art], passes=[CacheBytesPass()],
+                     budgets=_cache_budgets("decode_step", 4096))
+    assert not rep.errors
+    assert any(f.code == "within-budget" for f in rep.findings)
+
+
+def test_cache_bytes_pass_flags_f32_cache_in_quantized_config():
+    """The dtype regression the pass exists for: MXNET_KV_DTYPE promises
+    narrow reads but the data planes silently store f32."""
+    from mxnet_tpu.analysis.passes import CacheBytesPass
+
+    art = _stub("decode_step_q",
+                meta={"cache_bytes": 4096, "kv_dtype": "int8",
+                      "cache_data_dtypes": ["float32"]})
+    rep = run_passes([art], passes=[CacheBytesPass()],
+                     budgets=_cache_budgets("decode_step_q", 8192))
+    assert any(f.code == "f32-cache" and f.severity == "error"
+               for f in rep.errors)
+    # properly-narrow data is clean
+    ok = _stub("decode_step_q",
+               meta={"cache_bytes": 2048, "kv_dtype": "int8",
+                     "cache_data_dtypes": ["int8"]})
+    rep = run_passes([ok], passes=[CacheBytesPass()],
+                     budgets=_cache_budgets("decode_step_q", 8192))
+    assert not rep.errors
+
+
+def test_cache_bytes_pass_warns_without_committed_budget():
+    from mxnet_tpu.analysis.passes import CacheBytesPass
+
+    art = _stub("mystery", meta={"cache_bytes": 1024, "kv_dtype": None,
+                                 "cache_data_dtypes": ["float32"]})
+    rep = run_passes([art], passes=[CacheBytesPass()])
+    assert any(f.code == "no-budget" and f.severity == "warning"
+               for f in rep.findings)
 
 
 if __name__ == "__main__":

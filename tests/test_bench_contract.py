@@ -18,10 +18,17 @@ Tier-1 smoke run of the decode benchmark.
 
 `benchmarks/bench_decode.py --smoke` drives the KV-cached serving path
 (prefill program, donated decode-step program, recompute baseline,
-continuous-batching server) at tiny dims and must emit the bench.py
-metric contract plus the decode accounting fields — including the
-HLO-level dot-FLOP counts behind the O(1)-in-prefix assertion, which the
-bench itself enforces (nonzero exit on regression).
+mixed-length continuous-batching serve in BOTH configurations — the PR-4
+dense-cache baseline and speculation x int8-quantized caches) at tiny
+dims and must emit the bench.py metric contract plus the decode
+accounting fields — the HLO-level dot-FLOP counts behind the
+O(1)-in-prefix assertion (which the bench itself enforces, nonzero exit
+on regression), the speculative accept-rate/steps accounting, and the
+static cache-byte + tokens/s/GB capacity headline.  The >= 2x
+serve-rate acceptance line is asserted by the bench itself at full dims;
+the smoke pins the noise-free halves (steps ratio, accept rate, cache
+bytes) and only reports the wall-clock ratio, because this harness's
+wall clock is shared-machine noise.
 """
 import json
 import os
@@ -107,9 +114,12 @@ def test_bench_long_context_smoke_contract():
 def test_bench_decode_smoke_contract():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
-    # scrub inherited bench/decode knobs so the smoke measures the defaults
+    # scrub inherited bench/decode/speculation/quantization knobs so the
+    # smoke measures the defaults (the dense baseline must stay dense)
     for key in [k for k in env if k.startswith("BENCH_")
-                or k.startswith("MXNET_DECODE_")]:
+                or k.startswith("MXNET_DECODE_")
+                or k.startswith("MXNET_SPEC_")
+                or k == "MXNET_KV_DTYPE"]:
         env.pop(key)
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "benchmarks",
@@ -128,26 +138,52 @@ def test_bench_decode_smoke_contract():
     # cached decode must beat recompute-the-prefix even at smoke dims
     assert head["vs_baseline"] > 1.0, head
     for key in ("prefill_tokens_per_sec", "decode_tokens_per_sec",
-                "serve_tokens_per_sec", "decode_step_dot_flops",
+                "serve_tokens_per_sec", "serve_spec_quant_tokens_per_sec",
+                "tokens_per_sec_per_gb", "decode_step_dot_flops",
                 "full_forward_dot_flops"):
         assert key in head and head[key] > 0, (key, head)
     # the statically-counted O(1)-in-prefix relation the bench asserts
     assert head["decode_step_dot_flops"] * 4 <= head["full_forward_dot_flops"]
 
+    # --- the speculation x quantization contract ---
+    # deterministic halves first (immune to shared-machine noise):
+    # quantized caches must be at most ~half the f32 bytes (int8 data +
+    # fp32 per-head scales), the n-gram draft must be accepted often
+    # enough to matter, and the verify pass must cut device steps per
+    # served token by >= 2x — the count ratio that IS the >= 2x win the
+    # wall clock shows at full dims
+    assert head["cache_bytes_per_slot_quant"] * 2 <= \
+        head["cache_bytes_per_slot_f32"] * 1.2, head
+    assert head["accept_rate"] >= 0.3, head
+    assert head["serve_steps_ratio"] >= 2.0, head
+    # the wall-clock ratio is REPORTED here but asserted only by the
+    # bench's own full-dims (T=2048) run: on this shared harness a busy
+    # neighbor can make any one drain arbitrarily slow, and the
+    # deterministic halves above already pin the win
+    assert head["vs_pr4_serve"] > 0, head
+
     # stderr: one JSON per phase, all phases present
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     phases = {r.get("phase") for r in rows}
-    assert {"flops", "prefill", "decode", "naive", "serve"} <= phases, phases
+    assert {"flops", "prefill", "decode", "naive", "serve",
+            "serve_spec_quant"} <= phases, phases
+    spec_row = next(r for r in rows if r.get("phase") == "serve_spec_quant")
+    dense_row = next(r for r in rows if r.get("phase") == "serve")
+    assert spec_row["spec_steps"] > 0
+    assert spec_row["decode_steps"] * 2 <= dense_row["decode_steps"]
 
 
 def test_mxlint_smoke_contract():
-    """`tools/mxlint.py --smoke` must audit all five canonical programs
-    with all five passes and report ZERO unsuppressed findings — the
-    static-analysis acceptance line: donation aliasing, collective
-    budgets, retrace counts, host-sync lint and FLOP/dtype coverage all
-    green against benchmarks/budgets.json on the 8-virtual-device CPU
-    platform."""
+    """`tools/mxlint.py --smoke` must audit all eight canonical programs
+    (the speculative trio — draft_step / verify_step / decode_step_q —
+    driven by a real mixed-length speculative serve) with all six passes
+    and report ZERO unsuppressed findings — the static-analysis
+    acceptance line: donation aliasing, collective budgets, retrace
+    counts (exactly one trace each for draft, verify and decode
+    programs), host-sync lint, FLOP/dtype coverage and cache-byte
+    budgets all green against benchmarks/budgets.json on the
+    8-virtual-device CPU platform."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     # scrub analysis knobs: the smoke must measure the committed budget
@@ -169,12 +205,23 @@ def test_mxlint_smoke_contract():
     assert head["value"] == 0 and head["vs_baseline"] == 1.0, head
     assert head["errors"] == 0 and head["warnings"] == 0, head
     # every canonical program was built (the virtual mesh gives ring×TP)
-    assert head["programs"] == 5 and head["passes"] == 5, head
+    assert head["programs"] == 8 and head["passes"] == 6, head
     assert head["skipped_programs"] == [], head
 
     # stderr: one JSON finding per line; every (pass, program) pair ran
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     pairs = {(r["pass"], r["program"]) for r in rows if "pass" in r}
-    assert len(pairs) == 25, sorted(pairs)
+    assert len(pairs) == 48, sorted(pairs)
     assert all(r["severity"] == "info" for r in rows if "pass" in r), rows
+    # the quantized decode/verify programs really carry narrow caches
+    # within their committed ceilings (not the f32 fallback)
+    cache_rows = {r["program"]: r for r in rows
+                  if r.get("pass") == "cache-bytes"
+                  and r["code"] == "within-budget"}
+    for prog in ("decode_step", "decode_step_q", "draft_step",
+                 "verify_step"):
+        assert prog in cache_rows, sorted(cache_rows)
+    assert cache_rows["decode_step_q"]["detail"]["kv_dtype"] == "int8"
+    assert cache_rows["decode_step_q"]["detail"]["measured"] * 2 <= \
+        cache_rows["decode_step"]["detail"]["measured"] * 1.2

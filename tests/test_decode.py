@@ -331,3 +331,360 @@ def test_cache_append_multi_token_wrap_keeps_latest():
     # token at position p (3..6) sits at slot p % c
     for p in range(7 - c, 7):
         np.testing.assert_array_equal(got[0, p % c], new[0, p])
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (PR 6): distribution preservation, padded batches,
+# ring-wrap gating, serving-loop interaction.
+# ---------------------------------------------------------------------------
+
+def test_speculative_greedy_matches_plain_generate():
+    """Greedy speculative decoding emits EXACTLY the target-only greedy
+    sequence — n-gram proposer AND draft-model proposer, on a padded
+    batch whose rows prefill to different lengths (the padded-prefill x
+    speculative-verify interaction)."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(20)
+    x = rng.randint(0, VOCAB, (B, 8)).astype(np.float32)
+    pred = DecodePredictor(sym, params, cache_len=2 * T)
+
+    ref = pred.generate(x, 8, max_new_tokens=8, seed=3)
+    got = pred.generate_speculative(x, 8, max_new_tokens=8, seed=3, k=3)
+    np.testing.assert_array_equal(ref, got)
+
+    # a smaller draft model over the same vocabulary
+    dsym, dparams = _lm_and_params(seed=9)
+    draft = DecodePredictor(dsym, dparams, cache_len=2 * T)
+    got_d = pred.generate_speculative(x, 8, max_new_tokens=8, seed=3, k=3,
+                                      draft=draft)
+    np.testing.assert_array_equal(ref, got_d)
+    # the draft's decode program traced exactly once across the run
+    assert draft.trace_counts["decode"] == 1
+
+    # padded batch: rows of different real lengths
+    lens = np.array([5, 8], np.int32)
+    xp = x.copy()
+    xp[0, 5:] = 0.0
+    ref_p = pred.generate(xp, lens, max_new_tokens=8, seed=3)
+    got_p = pred.generate_speculative(xp, lens, max_new_tokens=8, seed=3,
+                                      k=3)
+    np.testing.assert_array_equal(ref_p, got_p)
+
+
+def test_generate_speculative_eos_discards_window_tail():
+    """A row that hits EOS mid-speculation-window retires AT the EOS:
+    tokens match plain greedy through the EOS, and the row pads with its
+    last token afterwards (the window tail is discarded, same rule as
+    the serving loop)."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(32)
+    x = rng.randint(0, VOCAB, (B, 6)).astype(np.float32)
+    pred = DecodePredictor(sym, params, cache_len=4 * T)
+    ref = pred.generate(x, 6, max_new_tokens=10, seed=2)
+    eos = next(int(ref[0][i]) for i in range(1, 10)
+               if ref[0][i] != ref[0][0])
+    got = pred.generate_speculative(x, 6, max_new_tokens=10, seed=2, k=3,
+                                    eos_id=eos)
+    e0 = int(np.flatnonzero(ref[0] == eos)[0])
+    np.testing.assert_array_equal(got[0, :e0 + 1], ref[0, :e0 + 1])
+    assert (got[0, e0:] == eos).all()
+
+
+def test_speculative_gates_off_at_ring_wrap_boundary():
+    """With a cache too short for the whole generation, speculation must
+    fall back to plain steps near the wrap boundary — and still equal
+    plain greedy generation token for token (the fallback shares its
+    programs, so nothing retraces either)."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(21)
+    x = rng.randint(0, VOCAB, (B, 6)).astype(np.float32)
+    pred = DecodePredictor(sym, params, cache_len=12)
+    ref = pred.generate(x, 6, max_new_tokens=10, seed=1)
+    got = pred.generate_speculative(x, 6, max_new_tokens=10, seed=1, k=3)
+    np.testing.assert_array_equal(ref, got)
+    assert pred.trace_counts["verify"] <= 1
+    assert pred.trace_counts["decode"] == 1
+
+
+def test_residual_probs_identity():
+    """The acceptance-rejection identity that makes speculative sampling
+    exact: q(v) min(1, p(v)/q(v)) + P(reject) res(v) == p(v)."""
+    from mxnet_tpu.ops.sample import residual_probs
+
+    rng = np.random.RandomState(3)
+    for _ in range(16):
+        p = rng.dirichlet(np.ones(7)).astype(np.float32)
+        q = rng.dirichlet(np.ones(7)).astype(np.float32)
+        res = np.asarray(residual_probs(jnp.asarray(p), jnp.asarray(q)))
+        accept = q * np.minimum(1.0, p / q)
+        marginal = accept + (1.0 - accept.sum()) * res
+        np.testing.assert_allclose(marginal, p, rtol=1e-4, atol=1e-6)
+
+
+def test_speculative_accept_preserves_target_distribution():
+    """Monte-Carlo identity check on the kernel itself: over many keys,
+    the FIRST emitted token's empirical distribution equals the target's
+    row-0 distribution — for a stochastic draft whose tokens are DRAWN
+    from q (the theorem's precondition) and for a deterministic proposer
+    (delta q, any fixed proposal)."""
+    from mxnet_tpu.ops.sample import speculative_accept
+
+    rng = np.random.RandomState(4)
+    v, k, n = 5, 2, 4000
+    p = jnp.asarray(rng.dirichlet(np.ones(v), size=(1, k + 1))[None, 0]
+                    .reshape(1, k + 1, v).astype(np.float32))
+    q = jnp.asarray(rng.dirichlet(np.ones(v), size=(1, k))
+                    .reshape(1, k, v).astype(np.float32))
+    fixed_draft = jnp.asarray(rng.randint(0, v, (1, k)), jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+
+    def first_tok_stochastic(key):
+        kd, ka = jax.random.split(key)
+        draft = jax.vmap(
+            lambda kk, row: jax.random.categorical(kk, jnp.log(row)))(
+                jax.random.split(kd, k), q[0]).astype(jnp.int32)[None]
+        return speculative_accept(ka, p, draft, q, greedy=False)[1][0, 0]
+
+    def first_tok_delta(key):
+        return speculative_accept(key, p, fixed_draft, None,
+                                  greedy=False)[1][0, 0]
+
+    for name, fn in (("q-drawn", first_tok_stochastic),
+                     ("delta", first_tok_delta)):
+        toks = np.asarray(jax.jit(jax.vmap(fn))(keys))
+        emp = np.bincount(toks, minlength=v) / n
+        np.testing.assert_allclose(emp, np.asarray(p)[0, 0], atol=0.035,
+                                   err_msg=name)
+
+
+def test_speculative_stochastic_determinism():
+    """Fixed seed -> bit-identical speculative samples; seeds vary."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(22)
+    x = rng.randint(0, VOCAB, (B, 8)).astype(np.float32)
+    hot = DecodePredictor(sym, params, cache_len=2 * T, temperature=1.0,
+                          top_k=5)
+    s1 = hot.generate_speculative(x, 8, max_new_tokens=8, seed=11, k=3)
+    s2 = hot.generate_speculative(x, 8, max_new_tokens=8, seed=11, k=3)
+    np.testing.assert_array_equal(s1, s2)
+    draws = {tuple(hot.generate_speculative(x, 8, max_new_tokens=8,
+                                            seed=s, k=3)[0])
+             for s in range(5)}
+    assert len(draws) > 1, "speculative sampling never varied across seeds"
+
+
+# ---------------------------------------------------------------------------
+# Quantized KV caches (PR 6): parity, ring wrap, byte accounting.
+# ---------------------------------------------------------------------------
+
+# documented logit-parity tolerances (docs/inference.md): max |delta p|
+# against the f32 cache on teacher-forced decode
+_KV_TOLS = {"int8": 2e-3, "float8_e4m3fn": 1e-2, "float8_e5m2": 3e-2}
+
+
+@pytest.mark.parametrize("kv_dtype", sorted(_KV_TOLS))
+def test_quantized_cache_logit_parity(kv_dtype):
+    """int8/fp8 caches reproduce the f32-cache output distributions
+    within the documented tolerance, prefill AND teacher-forced decode."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(23)
+    x = rng.randint(0, VOCAB, (B, T)).astype(np.float32)
+    pred = DecodePredictor(sym, params, cache_len=T)
+    qpred = DecodePredictor(sym, params, cache_len=T, kv_dtype=kv_dtype)
+    tol = _KV_TOLS[kv_dtype]
+    s0, p0 = pred.prefill(x[:, :8], 8)
+    s1, p1 = qpred.prefill(x[:, :8], 8)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p0), atol=tol)
+    for t in range(8, 12):
+        # two copies: each step donates its own state's token buffer
+        s0 = s0._replace(tok=jnp.asarray(x[:, t:t + 1], jnp.int32))
+        s1 = s1._replace(tok=jnp.asarray(x[:, t:t + 1], jnp.int32))
+        s0, p0 = pred.step(s0)
+        s1, p1 = qpred.step(s1)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p0),
+                                   atol=tol, err_msg="t=%d" % t)
+    # the caches really store narrow data (not silently f32)
+    kc = s1.caches[0][0]
+    assert isinstance(kc, attn.QuantKV)
+    assert str(kc.data.dtype) == kv_dtype
+    assert kc.scale.dtype == jnp.float32
+    # and the static byte accounting sees the shrink
+    assert qpred.cache_bytes(s1) < pred.cache_bytes(s0)
+
+
+def test_quantized_cache_scale_replicates_when_heads_dont_divide():
+    """E % model == 0 but heads % model != 0 (legal for the f32 cache —
+    an E-split finer than a head split): the quantized data plane still
+    E-splits while the (B, C, H) scale plane REPLICATES instead of
+    erroring at trace time, and logits match the unsharded predictor."""
+    from mxnet_tpu.parallel import MeshConfig, build_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device harness")
+    mesh = build_mesh(MeshConfig(data=2, seq=1, model=4))  # heads=2 % 4 != 0
+
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(31)
+    x = rng.randint(0, VOCAB, (B, T)).astype(np.float32)
+    plain = DecodePredictor(sym, params, cache_len=T, kv_dtype="int8")
+    shard = DecodePredictor(sym, params, cache_len=T, kv_dtype="int8",
+                            mesh=mesh)
+    s_state, s_probs = shard.prefill(x[:, :8], 8)
+    p_state, p_probs = plain.prefill(x[:, :8], 8)
+    kc = s_state.caches[0][0]
+    assert "model" in tuple(kc.data.sharding.spec), kc.data.sharding
+    assert "model" not in tuple(kc.scale.sharding.spec), kc.scale.sharding
+    np.testing.assert_allclose(np.asarray(s_probs), np.asarray(p_probs),
+                               rtol=1e-4, atol=1e-5)
+    for _ in range(3):
+        s_state, s_probs = shard.step(s_state)
+        p_state, p_probs = plain.step(p_state)
+        np.testing.assert_allclose(np.asarray(s_probs),
+                                   np.asarray(p_probs),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_quantized_ring_wrap_matches_dense_window():
+    """Sliding-window parity at ring wrap with a QUANTIZED cache: decode
+    attention over the wrapped int8 ring equals dense attention over the
+    dequantized window — bit-for-bit the same numerics, only the storage
+    is narrow."""
+    rng = np.random.RandomState(24)
+    c, e, total = 8, EMBED, 13
+    ks = rng.normal(size=(1, total, e)).astype(np.float32)
+    vs = rng.normal(size=(1, total, e)).astype(np.float32)
+    qs = rng.normal(size=(1, total, e)).astype(np.float32)
+
+    kc = attn.QuantKV(jnp.zeros((1, c, e), jnp.int8),
+                      jnp.zeros((1, c, HEADS), jnp.float32))
+    vc = attn.QuantKV(jnp.zeros((1, c, e), jnp.int8),
+                      jnp.zeros((1, c, HEADS), jnp.float32))
+    for t in range(total):
+        kc = attn.cache_append(kc, jnp.asarray(ks[:, t:t + 1]), t,
+                               num_heads=HEADS)
+        vc = attn.cache_append(vc, jnp.asarray(vs[:, t:t + 1]), t,
+                               num_heads=HEADS)
+        out = attn.sdpa_decode(jnp.asarray(qs[:, t:t + 1]), kc, vc, t + 1,
+                               num_heads=HEADS)
+        # reference: dense attention over the DEQUANTIZED live window
+        lo = max(0, t + 1 - c)
+        kd = np.asarray(attn.dequantize_kv(kc, HEADS))
+        vd = np.asarray(attn.dequantize_kv(vc, HEADS))
+        win_k = np.stack([kd[0, p % c] for p in range(lo, t + 1)])[None]
+        win_v = np.stack([vd[0, p % c] for p in range(lo, t + 1)])[None]
+        ref = attn.sdpa(jnp.asarray(qs[:, t:t + 1]), jnp.asarray(win_k),
+                        jnp.asarray(win_v), num_heads=HEADS)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg="wrap mismatch at t=%d" % t)
+
+
+def test_quantize_dequantize_roundtrip_error_bound():
+    """Per-(token, head) scales bound the int8 roundtrip error by
+    amax_head / 127 per element."""
+    rng = np.random.RandomState(25)
+    x = rng.normal(size=(2, 5, EMBED)).astype(np.float32) * 3.0
+    q = attn.quantize_kv(jnp.asarray(x), jnp.int8, num_heads=HEADS)
+    back = np.asarray(attn.dequantize_kv(q, HEADS))
+    amax = np.abs(x.reshape(2, 5, HEADS, -1)).max(-1, keepdims=True)
+    bound = np.broadcast_to(amax / 127.0 * 0.5 + 1e-6,
+                            x.reshape(2, 5, HEADS, -1).shape)
+    assert (np.abs(back.reshape(2, 5, HEADS, -1)
+                   - x.reshape(2, 5, HEADS, -1)) <= bound).all()
+
+
+# ---------------------------------------------------------------------------
+# Serving-loop speculation (PR 6): equality, EOS mid-window, accounting.
+# ---------------------------------------------------------------------------
+
+def test_spec_quant_server_matches_plain_generation():
+    """The speculative server over quantized caches returns EXACTLY what
+    single-sequence greedy generation (same quantized predictor) returns
+    for every prompt — slot reuse, mixed lengths and all."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(26)
+    prompts = [rng.randint(0, VOCAB, (n,)) for n in (5, 7, 4, 6, 5)]
+    max_new = 5
+    qpred = DecodePredictor(sym, params, cache_len=T, kv_dtype="int8")
+    refs = [qpred.generate(p[None].astype(np.float32), p.size,
+                           max_new_tokens=max_new, seed=0)[0]
+            for p in prompts]
+    server = DecodeServer(qpred, max_prefill=T, slots=2,
+                          max_new_tokens=max_new, spec_k=3)
+    ids = [server.submit(p) for p in prompts]
+    results = server.run()
+    for rid, ref in zip(ids, refs):
+        np.testing.assert_array_equal(results[rid], ref)
+    assert server.spec_steps > 0
+    assert server.proposed == 3 * server.spec_steps * 2 or \
+        server.proposed > 0      # slots may idle on the last drain
+    assert 0.0 <= server.accept_rate <= 1.0
+    # the verify program traced exactly once across the whole serve
+    assert qpred.trace_counts["verify"] == 1
+
+
+def test_draft_catch_up_keeps_self_draft_acceptance_perfect():
+    """Draft == target: with a COMPLETE draft cache every window fully
+    accepts (accept_rate exactly 1).  A draft that misses committed
+    K/V — the k-th token of a fully-accepted window, or fallback-era
+    tokens — diverges from the target and breaks perfection, so this
+    pins the DraftProposer teacher-forced catch-up."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(30)
+    pred = DecodePredictor(sym, params, cache_len=4 * T)
+    draft = DecodePredictor(sym, params, cache_len=4 * T)
+    prompts = [rng.randint(0, VOCAB, (n,)) for n in (5, 7, 6, 4)]
+    refs = [pred.generate(p[None].astype(np.float32), p.size,
+                          max_new_tokens=20, seed=0)[0] for p in prompts]
+    server = DecodeServer(pred, max_prefill=T, slots=2,
+                          max_new_tokens=20, spec_k=3, draft=draft)
+    ids = [server.submit(p) for p in prompts]
+    results = server.run()
+    for rid, ref in zip(ids, refs):
+        np.testing.assert_array_equal(results[rid], ref)
+    assert server.spec_steps > 0
+    assert server.accept_rate == 1.0, server.accept_rate
+
+
+def test_server_eos_retirement_mid_speculation_window():
+    """EOS emitted MID-window: the request retires with the window's
+    later tokens discarded, the freed slot serves the next request, and
+    token accounting counts only delivered tokens."""
+    sym, params = _lm_and_params()
+    rng = np.random.RandomState(27)
+    pred = DecodePredictor(sym, params, cache_len=T)
+    prompt = rng.randint(0, VOCAB, (6,))
+    # greedy continuation: pick as "EOS" the first token that differs
+    # from the prefill's, so it is emitted inside a k=4 speculation
+    # window (not at admission) and the window's tail must be discarded
+    ref = pred.generate(prompt[None].astype(np.float32), 6,
+                        max_new_tokens=8)[0]
+    eos = next(int(ref[i]) for i in range(1, len(ref))
+               if ref[i] != ref[0])
+    ref_len = int(np.flatnonzero(ref == eos)[0]) + 1
+    server = DecodeServer(pred, max_prefill=T, slots=1, eos_id=eos,
+                          max_new_tokens=64, spec_k=4)
+    ids = [server.submit(prompt) for _ in range(3)]
+    results = server.run()
+    for rid in ids:
+        np.testing.assert_array_equal(results[rid], ref[:ref_len])
+        assert results[rid][-1] == eos
+    assert server.tokens_out == 3 * ref_len
+    assert server.spec_steps > 0
+
+
+def test_sample_tokens_greedy_bypass_is_key_independent():
+    """Satellite: temperature=0 AND top_k=1 both take the pure-argmax
+    path — bit-identical across PRNG keys (no fold-in on the hot
+    path)."""
+    logits = jnp.asarray(np.log([[0.05, 0.1, 0.4, 0.3, 0.15]] * 3,
+                                dtype=np.float32))
+    outs = set()
+    for s in range(5):
+        key = jax.random.PRNGKey(s)
+        outs.add(tuple(np.asarray(sample_tokens(key, logits,
+                                                temperature=0.0))))
+        outs.add(tuple(np.asarray(sample_tokens(key, logits,
+                                                temperature=0.7,
+                                                top_k=1))))
+    assert outs == {(2, 2, 2)}

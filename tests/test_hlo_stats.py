@@ -142,8 +142,47 @@ def test_dot_flops_hlo_dot():
     assert dot_flops(line) == 2 * 8 * 512 * 128
 
 
-def test_dot_flops_convolution_reported_uncounted():
-    # convolutions contribute zero FLOPs — but no longer silently
+def test_dot_flops_stablehlo_convolution_counted():
+    # conv FLOPs are modeled (carried-forward ROADMAP gap): contraction =
+    # kernel i dim x spatial dims, read from the rhs dim_numbers group —
+    # 2 * (1*4*6*6) * (3 * 3*3) for a 3x3 conv, 3 in / 4 out channels
+    line = ("%4 = stablehlo.convolution(%1, %2) dim_numbers = "
+            "[b, f, 0, 1]x[o, i, 0, 1]->[b, f, 0, 1], window = "
+            "{stride = [1, 1]} {feature_group_count = 1 : i64} : "
+            "(tensor<1x3x8x8xf32>, tensor<4x3x3x3xf32>) "
+            "-> tensor<1x4x6x6xf32>")
+    rep = dot_flops_report(line)
+    assert rep["flops"] == 2 * (1 * 4 * 6 * 6) * (3 * 3 * 3)
+    assert rep["dots"][0]["op"] == "stablehlo.convolution"
+    assert rep["dots"][0]["dtype"] == "f32"
+    assert rep["uncounted_ops"] == []
+
+
+def test_dot_flops_hlo_convolution_counted():
+    # HLO dialect: kernel dim roles from dim_labels' middle group (oi01)
+    line = ("  %conv.1 = f32[1,4,6,6]{3,2,1,0} convolution("
+            "f32[1,3,8,8]{3,2,1,0} %x, f32[4,3,3,3]{3,2,1,0} %w), "
+            "window={size=3x3}, dim_labels=bf01_oi01->bf01")
+    rep = dot_flops_report(line)
+    assert rep["flops"] == 2 * (1 * 4 * 6 * 6) * (3 * 3 * 3)
+    assert rep["dots"][0]["op"] == "convolution"
+    assert rep["uncounted_ops"] == []
+
+
+def test_dot_flops_grouped_convolution_counted():
+    # feature_group_count > 1: the IR kernel's i dim is ALREADY C_in/g,
+    # so no special casing — 16 in channels, 4 groups -> i = 4
+    line = ("%4 = stablehlo.convolution(%1, %2) dim_numbers = "
+            "[b, f, 0, 1]x[o, i, 0, 1]->[b, f, 0, 1], window = {} "
+            "{feature_group_count = 4 : i64} : "
+            "(tensor<1x16x8x8xf32>, tensor<8x4x3x3xf32>) "
+            "-> tensor<1x8x6x6xf32>")
+    assert dot_flops(line) == 2 * (1 * 8 * 6 * 6) * (4 * 3 * 3)
+
+
+def test_dot_flops_labelless_convolution_reported_uncounted():
+    # convolutions WITHOUT dim metadata cannot be modeled — they must
+    # surface as uncounted, not read as zero silently
     text = """
 %4 = stablehlo.convolution(%1, %2) dim_numbers = [b, f, 0, 1] : (tensor<1x3x8x8xf32>, tensor<4x3x3x3xf32>) -> tensor<1x4x6x6xf32>
   %conv.1 = f32[1,4,6,6]{3,2,1,0} convolution(f32[1,3,8,8]{3,2,1,0} %x, f32[4,3,3,3]{3,2,1,0} %w), window={size=3x3}
@@ -152,6 +191,24 @@ def test_dot_flops_convolution_reported_uncounted():
     assert rep["flops"] == 0
     ops = {r["op"]: r["count"] for r in rep["uncounted_ops"]}
     assert ops == {"stablehlo.convolution": 1, "convolution": 1}
+
+
+def test_shape_str_renders_hlo_shapes():
+    # the inverse renderer feeding the cache-bytes budget (decode
+    # cache_bytes -> shape_bytes round trip, one width table)
+    import numpy as np
+
+    from mxnet_tpu.analysis.hlo_parse import shape_str
+
+    assert shape_str((2, 16, 8), np.int8) == "s8[2,16,8]"
+    assert shape_str((4,), np.float32) == "f32[4]"
+    assert shape_bytes(shape_str((2, 16, 8), np.int8)) == 256
+    import jax.numpy as jnp
+
+    assert shape_str((8,), jnp.float8_e4m3fn) == "f8e4m3fn[8]"
+    assert shape_bytes(shape_str((8,), jnp.float8_e4m3fn)) == 8
+    with pytest.raises(KeyError):
+        shape_str((2,), np.dtype("datetime64[s]"))
 
 
 def test_dot_flops_malformed_dot_reported_uncounted():

@@ -2,12 +2,14 @@
 """mxlint — static program-analysis lint over the framework's canonical
 compiled programs.
 
-Builds the five canonical programs on the current backend (``--smoke``
+Builds the eight canonical programs on the current backend (``--smoke``
 forces the 8-virtual-device CPU platform so the ring×TP mesh program
-exists on one box), snapshots each as a
+exists on one box; the speculative trio — draft_step / verify_step /
+decode_step_q — is driven by a real mixed-length speculative serve),
+snapshots each as a
 :class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` (jaxpr + lowered
-StableHLO + compiled HLO + donation/retrace/dtype metadata), and runs the
-five analysis passes against the committed budget file:
+StableHLO + compiled HLO + donation/retrace/dtype/cache metadata), and
+runs the six analysis passes against the committed budget file:
 
 ==================  =====================================================
 pass                invariant it pins
@@ -17,6 +19,8 @@ collective-budget   collective counts/bytes <= benchmarks/budgets.json
 retrace             one jit trace per program shape (no cache-key drift)
 host-sync           no host-callback primitives / host-transfer HLO ops
 flop-dtype          dot_flops coverage; no f32 dots in bf16 programs
+cache-bytes         decode KV-cache bytes <= ceiling; quantized configs
+                    store narrow data planes
 ==================  =====================================================
 
 Output follows the bench.py contract: ONE json line on stdout —
@@ -74,7 +78,7 @@ def _parse_args(argv):
         "compiled programs (see docs/static_analysis.md)")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 CI mode: force the 8-virtual-device CPU "
-                    "platform and audit all five programs")
+                    "platform and audit all eight programs")
     ap.add_argument("--programs", default="",
                     help="comma-filter of canonical programs (default all)")
     ap.add_argument("--budgets", default="",
@@ -135,6 +139,9 @@ def main(argv=None):
         path = analysis.resolve_budgets_path(budgets_path)
         programs = budgets.setdefault("programs", {})
         for art in artifacts:
+            if art.meta.get("cache_bytes") is not None:
+                programs.setdefault(art.name, {})["cache_bytes"] = \
+                    art.meta["cache_bytes"]
             if art.compiled_text is None:
                 continue
             stats = collective_stats(art.compiled_text)
