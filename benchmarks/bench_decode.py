@@ -24,6 +24,19 @@ attention-LM generating tokens through ``mxnet_tpu.decode`` —
     bandwidth-bound decode cost attacked at once.  The acceptance line:
     >= 2x the dense serve rate at T=2048, accept-rate reported.
 
+* **serve_paged** — the SHARED-SYSTEM-PROMPT mixed-length trace (N
+  requests x one common 256-token prefix + random tails), drained twice:
+  the PR-6 dense-ring spec x quant config (rings reserve the full T per
+  slot), and the paged config (``MXNET_KV_PAGED`` machinery: shared page
+  pools sized to the live-token working set, copy-on-write prefix
+  sharing so the common prefix prefills once, chunked prefill
+  interleaved with decode).  Paged serving is asserted token-identical
+  to the dense-ring drain (greedy), prefix_cache_hit_rate > 0,
+  trace_counts prove zero retraces across admissions/forks/retirements,
+  and the capacity headline ``serve_paged_tokens_per_sec_per_gb`` must
+  reach >= 2x the dense-ring tokens/s/GB at full dims (T=2048) — memory
+  is the serving bottleneck PagedAttention removes.
+
 The bench also ASSERTS the O(1)-in-prefix property statically: dot FLOPs
 (``parallel.hlo_stats.dot_flops``) of the lowered decode-step program must
 not grow with the prefix, while the full-forward program's roughly double
@@ -43,7 +56,8 @@ T=512).  Per-phase detail goes to stderr, one json per line.
 Env knobs: BENCH_T, BENCH_BATCH, BENCH_EMBED, BENCH_HEADS, BENCH_VOCAB,
 BENCH_LAYERS, BENCH_DECODE_STEPS, BENCH_NAIVE_STEPS, BENCH_DTYPE,
 BENCH_SPEC_K (draft width, default 8), BENCH_KV_DTYPE (default int8),
-BENCH_SERVE_REQS, BENCH_MAX_NEW.
+BENCH_SERVE_REQS, BENCH_MAX_NEW, BENCH_SHARED_REQS, BENCH_PAGE_TOKENS,
+BENCH_PREFILL_CHUNK.
 ``--smoke``: the tier-1 CI entry — tiny dims on the forced-CPU platform
 (tests/test_bench_contract.py invokes it).
 """
@@ -201,38 +215,43 @@ def main():
              for i in range(n_reqs)]
     total_cap = sum(cap for _, cap in trace)
 
-    def run_serve(p, **kw):
+    def run_serve(p, workload=None, window=None, **kw):
         # admissions prefill at the trace's prompt ceiling, not the full
         # cache width: padding every admission to T would charge a whole
         # T-wide forward per request (both configs alike) and drown the
         # decode-side comparison the serve exists to measure
-        server = DecodeServer(p, max_prefill=hi, slots=slots, **kw)
-        # warmup drain: compile the (1, T) prefill, step/verify and the
-        # slot-splice programs OUTSIDE the timed region (the dense
-        # baseline's were already warmed by the earlier phases)
+        wtrace = trace if workload is None else workload
+        wcap = sum(cap for _, cap in wtrace)
+        server = DecodeServer(p, max_prefill=window or hi, slots=slots,
+                              **kw)
+        # warmup drain: compile the (1, T) prefill (or the paged chunk /
+        # fork / commit programs), step/verify and the slot-splice
+        # programs OUTSIDE the timed region
         for _ in range(2):
-            server.submit(trace[0][0], max_new_tokens=2)
+            server.submit(wtrace[0][0], max_new_tokens=2)
         server.run()
         # best-of-N drains of the SAME trace: the serving loop's wall
         # clock rides the host scheduler, so the fastest drain is the
         # machine-noise-free estimate (both configs measured alike)
-        best = 0.0
+        best, results = 0.0, None
         for _ in range(3 if SMOKE else 2):
             server.steps = server.spec_steps = 0
             server.tokens_out = server.proposed = server.accepted = 0
-            for prompt, cap in trace:
-                server.submit(prompt, max_new_tokens=cap)
+            ids = [server.submit(prompt, max_new_tokens=cap)
+                   for prompt, cap in wtrace]
             tic = time.time()
-            results = server.run()
+            drained = server.run()
             dt = time.time() - tic
-            assert len(results) == n_reqs and server.tokens_out == total_cap
+            assert len(drained) == len(wtrace) \
+                and server.tokens_out == wcap
             best = max(best, server.tokens_out / dt)
-        return server, best
+            results = [drained[rid] for rid in ids]
+        return server, best, results
 
     # PR-4 configuration: dense f32 caches, one token per step
     # (spec_k pinned 0 so an ambient MXNET_SPEC_K cannot turn the
     # baseline speculative and measure spec-vs-spec)
-    server_d, serve_tok_s = run_serve(pred, spec_k=0)
+    server_d, serve_tok_s, _ = run_serve(pred, spec_k=0)
     emit({"phase": "serve", "tokens_per_sec": round(serve_tok_s, 1),
           "requests": n_reqs, "slots": slots,
           "decode_steps": server_d.steps})
@@ -240,7 +259,7 @@ def main():
     # speculation x quantization on the SAME trace
     qpred = DecodePredictor(sym, params, cache_len=t, temperature=0.0,
                             kv_dtype=kv_dtype)
-    server_q, serve_sq_tok_s = run_serve(qpred, spec_k=spec_k)
+    server_q, serve_sq_tok_s, _ = run_serve(qpred, spec_k=spec_k)
     # static cache accounting (the mxlint cache-bytes pass's numbers),
     # per serving slot: the quantization win as capacity, not just speed
     one = np.zeros((1, hi), np.float32)
@@ -268,6 +287,88 @@ def main():
             "spec x quant serve is %.2fx the PR-4 dense baseline " \
             "(acceptance: >= 2x at T=%d)" % (vs_pr4, t)
 
+    # ---- shared-system-prompt trace: PR-6 dense rings vs paged+prefix --
+    # N requests share one common prefix (the million-user system-prompt
+    # shape) with random mixed-length tails; drained by the PR-6 config
+    # (dense rings reserving the full T per slot) and by the paged config
+    # (pool sized to the live-token working set, prefix shared, chunked
+    # prefill) — same spec x quant settings, so the delta IS the memory
+    # manager
+    prefix_len = int(os.environ.get("BENCH_PREFIX_LEN",
+                                    "32" if SMOKE else "256"))
+    page_tokens = int(os.environ.get("BENCH_PAGE_TOKENS", "16"))
+    n_shared = int(os.environ.get("BENCH_SHARED_REQS", str(3 * slots)))
+    prefix = trace_rng.randint(0, vocab, size=(prefix_len,))
+    tail_lo, tail_hi = max(1, t // 16), max(2, t // 8)
+    strace = [(np.concatenate(
+        [prefix, trace_rng.randint(0, vocab, size=(
+            trace_rng.randint(tail_lo, tail_hi + 1),))]),
+        max_new if i % 2 == 0 else max(2, max_new // 2))
+        for i in range(n_shared)]
+    hi2 = max(p.size for p, _ in strace)
+
+    server_sd, shared_dense_tok_s, dense_out = run_serve(
+        qpred, workload=strace, window=hi2, spec_k=spec_k)
+
+    # paged capacity covers the worst-case live tokens of one request
+    # (prompt + cap + speculation window), NOT the full T — pages
+    # decouple the reservation from max-context, which is the whole win
+    paged_cap = -(-(hi2 + max_new + spec_k + 2) // page_tokens) \
+        * page_tokens
+    pool_pages = slots * (paged_cap // page_tokens) \
+        + -(-prefix_len // page_tokens) + 4
+    ppred = DecodePredictor(
+        sym, params, cache_len=paged_cap, temperature=0.0,
+        kv_dtype=kv_dtype, paged=True, page_tokens=page_tokens,
+        pool_pages=pool_pages,
+        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "64")))
+    server_p, paged_tok_s, paged_out = run_serve(
+        ppred, workload=strace, window=hi2, spec_k=spec_k)
+
+    # correctness first: greedy paged+prefix serving is token-identical
+    # to the dense-ring drain of the same trace
+    for i, (a, b) in enumerate(zip(dense_out, paged_out)):
+        assert np.array_equal(a, b), \
+            "paged serve diverged from dense-ring serve on request %d" % i
+    # zero retraces across admissions, COW forks and retirements: every
+    # paged program traced AT MOST once across warmup + all drains (a
+    # near-perfect accept rate can retire everything through verify
+    # passes alone, leaving the plain decode program legitimately at 0)
+    tc = ppred.trace_counts
+    assert tc["chunk"] == 1 and all(
+        tc[prog] <= 1 for prog in ("decode", "verify", "fork", "commit")), tc
+    pstats = server_p.stats()
+    assert pstats["prefix_cache_hit_rate"] > 0, pstats
+
+    pool_gb = ppred.pool_bytes() / 1e9
+    dense_gb = bytes_q * slots / 1e9
+    paged_tok_s_per_gb = paged_tok_s / pool_gb
+    shared_dense_tok_s_per_gb = shared_dense_tok_s / dense_gb
+    vs_pr6_per_gb = paged_tok_s_per_gb / shared_dense_tok_s_per_gb
+    emit({"phase": "serve_paged",
+          "tokens_per_sec": round(paged_tok_s, 1),
+          "dense_ring_tokens_per_sec": round(shared_dense_tok_s, 1),
+          "requests": n_shared, "slots": slots,
+          "prefix_len": prefix_len, "page_tokens": page_tokens,
+          "pool_pages": pool_pages, "paged_cache_len": paged_cap,
+          "pool_bytes": ppred.pool_bytes(),
+          "dense_ring_bytes": bytes_q * slots,
+          "decode_steps": server_p.steps,
+          "spec_steps": server_p.spec_steps,
+          "prefix_cache_hit_rate":
+              round(pstats["prefix_cache_hit_rate"], 3),
+          "kv_hbm_utilization":
+              round(pstats["kv_hbm_utilization"], 3),
+          "cow_forks": pstats["cow_forks"],
+          "tokens_per_sec_per_gb": round(paged_tok_s_per_gb, 1),
+          "vs_pr6_per_gb": round(vs_pr6_per_gb, 3)})
+    if not SMOKE:
+        # the paging acceptance line at full dims: >= 2x the PR-6
+        # dense-ring capacity headline on the shared-prefix trace
+        assert vs_pr6_per_gb >= 2.0, \
+            "paged serve is %.2fx the dense-ring tokens/s/GB " \
+            "(acceptance: >= 2x at T=%d)" % (vs_pr6_per_gb, t)
+
     print(json.dumps({
         "metric": "decode_tokens_per_sec_t%d" % t,
         "value": round(decode_tok_s, 1),
@@ -285,6 +386,12 @@ def main():
         "cache_bytes_per_slot_f32": bytes_f32,
         "cache_bytes_per_slot_quant": bytes_q,
         "tokens_per_sec_per_gb": round(tok_s_per_gb, 1),
+        "serve_paged_tokens_per_sec": round(paged_tok_s, 1),
+        "serve_paged_tokens_per_sec_per_gb": round(paged_tok_s_per_gb, 1),
+        "vs_pr6_per_gb": round(vs_pr6_per_gb, 3),
+        "prefix_cache_hit_rate": round(pstats["prefix_cache_hit_rate"], 3),
+        "kv_hbm_utilization": round(pstats["kv_hbm_utilization"], 3),
+        "pool_bytes": ppred.pool_bytes(),
         "decode_step_dot_flops": f_decode,
         "full_forward_dot_flops": f_full,
     }))

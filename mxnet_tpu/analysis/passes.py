@@ -20,7 +20,11 @@ the 8-virtual-device CPU mesh instead of silently regressing a headline:
   sized through the f8/sub-byte-aware width table) stay within the
   committed ceiling, and a quantized config must actually store narrow
   data (an f32 data plane under MXNET_KV_DTYPE is an error — decode is
-  bandwidth-bound on exactly these bytes).
+  bandwidth-bound on exactly these bytes).  Paged layouts are understood:
+  the budget is the shared POOL's bytes (the whole serving HBM bill, not
+  per-slot rings), and a dense-ring allocation under ``MXNET_KV_PAGED=1``
+  is an error — the config promises paged memory management the program
+  no longer performs.
 """
 from __future__ import annotations
 
@@ -323,24 +327,31 @@ _NARROW_CACHE_DTYPES = ("int8", "float8_e4m3fn", "float8_e5m2",
 
 class CacheBytesPass(Pass):
     """Decode KV-cache bytes vs the committed ceiling; quantized configs
-    must store narrow data.
+    must store narrow data; paged configs must store pages.
 
     Decode is bandwidth-bound on the cache: every step streams the whole
     (B, C, E) K/V per layer, so cache bytes ARE the serving-cost
     denominator (``bench_decode.py``'s tokens/s/GB headline).  The
     decode-layer artifacts record ``meta['cache_bytes']`` — data plus
     per-(token, head) scale planes, sized statically through
-    ``hlo_parse.shape_bytes``'s width table (f8/sub-byte aware) — and
-    ``meta['kv_dtype']``/``meta['cache_data_dtypes']``.  Budget layout::
+    ``hlo_parse.shape_bytes``'s width table (f8/sub-byte aware) — plus
+    ``meta['kv_dtype']``/``meta['cache_data_dtypes']`` and
+    ``meta['cache_layout']`` ('dense' ring buffers per slot, or 'paged':
+    shared page pools whose recorded bytes are the POOL total — the
+    serving HBM bill a page-table regression would silently re-inflate).
+    Budget layout::
 
         {"programs": {"<program>": {"cache_bytes": N}}}
 
     Findings: bytes over the ceiling = error (a dtype regression silently
     doubling the cache); a quantized ``kv_dtype`` whose data planes are
     full-precision = error (the quantize plumbing got dropped — the
-    config promises narrow reads it no longer performs); no committed
-    ceiling = warning nudging ``--update-budgets`` hygiene.  Programs
-    without cache metadata (training steps) skip with an info row.
+    config promises narrow reads it no longer performs); a dense-ring
+    allocation under a paged config (``meta['kv_paged']``) = error (the
+    page-pool plumbing got dropped — HBM scales with slots x max-context
+    again); no committed ceiling = warning nudging ``--update-budgets``
+    hygiene.  Programs without cache metadata (training steps) skip with
+    an info row.
     """
 
     name = "cache-bytes"
@@ -355,6 +366,15 @@ class CacheBytesPass(Pass):
         findings = []
         kv_dtype = artifact.meta.get("kv_dtype")
         data_dtypes = artifact.meta.get("cache_data_dtypes") or []
+        layout = artifact.meta.get("cache_layout")
+        if artifact.meta.get("kv_paged") and layout == "dense":
+            findings.append(self.finding(
+                artifact, "error",
+                "MXNET_KV_PAGED promises paged KV caches but this program "
+                "allocates dense ring buffers — the page-pool plumbing "
+                "was dropped and serving HBM scales with "
+                "slots x max-context again",
+                code="dense-under-paged", layout=layout))
         if kv_dtype:
             wide = [d for d in data_dtypes
                     if d not in _NARROW_CACHE_DTYPES]
@@ -385,8 +405,9 @@ class CacheBytesPass(Pass):
         if not findings:
             findings.append(self.finding(
                 artifact, "info",
-                "cache within budget: %d <= %d bytes (kv_dtype=%s)"
-                % (cache_bytes, ceiling, kv_dtype or "full-precision"),
+                "cache within budget: %d <= %d bytes (kv_dtype=%s, %s)"
+                % (cache_bytes, ceiling, kv_dtype or "full-precision",
+                   layout or "dense"),
                 code="within-budget", measured=cache_bytes,
-                budget=ceiling, kv_dtype=kv_dtype))
+                budget=ceiling, kv_dtype=kv_dtype, layout=layout))
         return findings

@@ -1,6 +1,6 @@
 """Builders for the canonical programs the lint audits.
 
-``tools/mxlint.py`` (and the tier-1 smoke) checks eight programs — the
+``tools/mxlint.py`` (and the tier-1 smoke) checks ten programs — the
 compiled surfaces behind every headline number so far:
 
 * ``train_step``  — the fused forward+backward+optimizer program
@@ -16,6 +16,12 @@ compiled surfaces behind every headline number so far:
   speculative serving loop (a second, smaller DecodePredictor);
 * ``verify_step`` — the speculative verify program: k+1 positions scored
   in one pass against the quantized caches, acceptance-rejection inside;
+* ``paged_decode_step`` / ``paged_verify_step`` — the same decode and
+  verify programs over SHARED page pools: per-slot page tables and
+  active masks ride in as data (zero retraces across admissions, COW
+  forks and retirements), appends scatter through the tables, attention
+  runs over the gathered ring view; their cache-bytes meta is the POOL
+  total (the paged serving HBM bill the cache-bytes pass budgets);
 * ``ring_tp_step`` — the attention-LM fused step on the composed
   (data, seq, model) mesh: ring attention with head groups sharded on
   'model' (needs >= 4 devices; the smoke forces the 8-virtual-device
@@ -28,6 +34,9 @@ speculative/quantized programs are driven by an actual MIXED-LENGTH
 :class:`~mxnet_tpu.decode.DecodeServer` run (draft-model proposer,
 prompts of different lengths, slot reuse), so their one-trace-each
 retrace audit covers the real serving schedule, not a synthetic drive.
+The two paged programs are likewise driven by a real SHARED-PREFIX paged
+serve — chunked prefill, prefix-cache hits, copy-on-write forks and
+immediate retirement all exercised before the trace counters snapshot.
 Dims are tiny: the point is the *program structure* (collectives,
 aliasing, callbacks, dot dtypes, cache bytes), which does not depend on
 size.
@@ -42,6 +51,7 @@ __all__ = ["CANONICAL_PROGRAMS", "build_canonical_artifacts"]
 
 CANONICAL_PROGRAMS = ("train_step", "eval_step", "prefill", "decode_step",
                       "decode_step_q", "draft_step", "verify_step",
+                      "paged_decode_step", "paged_verify_step",
                       "ring_tp_step")
 
 # tiny-but-structured dims shared by every builder
@@ -242,6 +252,47 @@ def _speculative_artifacts():
             target.verify_artifact(state, _SPEC_K, name="verify_step"))
 
 
+def _paged_artifacts():
+    """paged_decode_step / paged_verify_step, driven by a real
+    shared-prefix paged serve.
+
+    Four requests sharing a 6-token prefix drain through a
+    :class:`~mxnet_tpu.decode.DecodeServer` over a paged predictor
+    (chunked prefill, n-gram speculation): chunk admissions, prefix-cache
+    hits, a COW-relevant partial-page publish, speculative verify over
+    page tables and immediate retirement all run before the artifacts
+    snapshot — each program's trace counter must then read exactly one.
+    """
+    from mxnet_tpu.decode import DecodePredictor, DecodeServer
+
+    d = _LM
+    rng = np.random.RandomState(3)
+    pred = DecodePredictor(
+        _lm_symbol(), _lm_params(_lm_symbol(), d["batch"], d["seq_len"]),
+        cache_len=d["seq_len"], temperature=0.0, kv_dtype="",
+        paged=True, page_tokens=4, prefill_chunk=4)
+    server = DecodeServer(pred, max_prefill=12, slots=d["batch"],
+                          max_new_tokens=3, spec_k=_SPEC_K)
+    prefix = rng.randint(0, d["vocab"], size=(6,))
+    for n in (3, 5, 2, 4):          # shared prefix, mixed tails
+        server.submit(np.concatenate(
+            [prefix, rng.randint(0, d["vocab"], size=(n,))]))
+    results = server.run()
+    stats = server.stats()
+    if len(results) != 4 or server.spec_steps == 0 \
+            or stats.get("prefix_cache_hit_rate", 0) <= 0:
+        raise MXNetError(
+            "paged serve drive did not exercise the paged programs "
+            "(results=%d, spec_steps=%d, hit_rate=%s)"
+            % (len(results), server.spec_steps,
+               stats.get("prefix_cache_hit_rate")))
+    # a fresh batch state at the same sizing lowers the SAME traces
+    state = pred.paged_batch_state(d["batch"])
+    return (pred.decode_artifact(state, name="paged_decode_step"),
+            pred.verify_artifact(state, _SPEC_K,
+                                 name="paged_verify_step"))
+
+
 def _ring_mesh_config(n_dev):
     from mxnet_tpu.parallel import MeshConfig
 
@@ -253,7 +304,7 @@ def _ring_mesh_config(n_dev):
 
 
 def build_canonical_artifacts(names=None):
-    """Build the requested canonical artifacts (default: all eight).
+    """Build the requested canonical artifacts (default: all ten).
 
     Returns ``(artifacts, notes)`` — ``notes`` maps a program that could
     not be built on this host (e.g. ``ring_tp_step`` without >= 4
@@ -294,6 +345,13 @@ def build_canonical_artifacts(names=None):
             artifacts.append(draft)
         if "verify_step" in want:
             artifacts.append(verify)
+
+    if {"paged_decode_step", "paged_verify_step"} & set(want):
+        paged_decode, paged_verify = _paged_artifacts()
+        if "paged_decode_step" in want:
+            artifacts.append(paged_decode)
+        if "paged_verify_step" in want:
+            artifacts.append(paged_verify)
 
     if "ring_tp_step" in want:
         cfg = _ring_mesh_config(len(jax.devices()))

@@ -192,6 +192,35 @@ register("MXNET_KV_DTYPE", str, "",
          "decode's bandwidth bound.  Empty (default) stores full-precision "
          "K/V.  The mxlint cache-bytes pass budgets the resulting cache "
          "size and flags an f32 cache in a quantized config.")
+register("MXNET_KV_PAGED", bool, False,
+         "Store decode KV caches as fixed-size pages in one shared device "
+         "pool per attention node instead of a dense ring buffer per slot "
+         "(decode.DecodePredictor paged mode + the mxnet_tpu.serve memory "
+         "manager): per-slot page tables are traced DATA, so admissions, "
+         "copy-on-write prefix forks and retirements never retrace, and "
+         "HBM scales with tokens actually live instead of "
+         "slots x max-context (vLLM's PagedAttention plan).  Arms prefix "
+         "sharing (matching prompts map their leading pages to shared "
+         "refcounted pages and prefill only the tail) and chunked prefill.")
+register("MXNET_KV_PAGE_TOKENS", int, 16,
+         "Tokens per KV page in paged mode.  Smaller pages waste less "
+         "memory on the last partial page per sequence and share prefixes "
+         "at finer granularity; larger pages mean fewer gather indices and "
+         "less page-table overhead.  cache_len must divide by it.")
+register("MXNET_KV_POOL_PAGES", int, 0,
+         "Total pages in the shared KV pool (page id 0 is reserved as the "
+         "scratch page).  0 (default) sizes the pool to fit every slot at "
+         "full capacity (slots x cache_len/page_tokens + 1) — safe but no "
+         "memory win; production serving sizes it to the live-token "
+         "working set and lets admission backpressure (mxnet_tpu.serve."
+         "PageAllocator reservations) queue requests that do not fit.")
+register("MXNET_PREFILL_CHUNK", int, 0,
+         "Chunk width for paged-mode prefill: prompts are admitted in "
+         "fixed-size chunks of this many tokens, interleaved with decode "
+         "steps, so a long prompt does not stall the whole serving batch "
+         "(one traced chunk program per width — still zero retraces).  "
+         "0 (default) prefills each prompt's tail in one chunk sized to "
+         "the admission window.")
 register("MXNET_SPEC_K", int, 0,
          "Tokens drafted per speculative-decoding step (decode.DecodeServer "
          "/ DecodePredictor.generate_speculative).  A proposer drafts k "

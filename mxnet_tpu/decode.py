@@ -54,6 +54,20 @@ factors of ``bytes/token = passes/token x cache bytes``:
   Scale buffers shard like the caches (``tp_rules.kv_cache_pspec`` — an
   H-split is the same head-group split).
 
+**Paged KV caches** (``MXNET_KV_PAGED`` / ``DecodePredictor(paged=True)``)
+replace the dense per-slot ring buffers with fixed-size pages in ONE shared
+device pool per attention node (PagedAttention, Kwon et al. SOSP 2023):
+per-slot page tables are traced *data* (``ops.attention.paged_gather`` /
+``paged_append`` index through them), so HBM scales with live tokens
+instead of slots x max-context and admissions / copy-on-write forks /
+retirements reuse the same compiled programs — the zero-retrace invariant
+extends to the memory manager.  The host half (refcounted allocator with
+admission reservations, the token-hash-chain prefix cache that lets
+matching prompts share their leading pages and prefill only the tail, the
+fork-before-divergent-write rule) lives in ``mxnet_tpu.serve``.  Prompts
+admit in fixed-size chunks (``MXNET_PREFILL_CHUNK``) interleaved with
+decode steps, so a long prompt never stalls the serving batch.
+
 The symbol contract (checked at trace time, documented in
 docs/inference.md): decoder-only graphs built from position-independent ops
 plus ``dot_product_attention`` for sequence mixing, with at most a learned
@@ -62,6 +76,7 @@ variable — ``models.attention_lm`` and the benchmark LMs qualify.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import NamedTuple
 
@@ -82,6 +97,16 @@ _KV_DTYPES = {
     "f8e4m3fn": "float8_e4m3fn",
     "float8_e5m2": "float8_e5m2", "f8e5m2": "float8_e5m2",
 }
+
+def _pad_window(tokens, width):
+    """``tokens`` left-aligned in a zero-padded (1, width) float32 window —
+    the ONE place admission padding and prefill-chunk windows are derived
+    (the dense admission path used to rebuild this per admit)."""
+    toks = np.asarray(tokens).reshape(-1)
+    out = np.zeros((1, int(width)), np.float32)
+    out[0, :toks.size] = toks
+    return out
+
 
 # broadcast ops through which a (1, S, E) position table may meet the
 # (B, t, E) activation stream; the decode walk gathers the table rows for
@@ -131,10 +156,24 @@ class DecodePredictor:
         (per-(token, head) scales, quantize-on-append / dequantize-in-
         kernel).  ``None`` (default) reads ``MXNET_KV_DTYPE``; empty
         string = full-precision caches.
+    paged : bool, optional
+        Store the caches as fixed-size pages in one shared pool per
+        attention node with per-slot page tables (traced data — see the
+        module docstring).  ``None`` (default) reads ``MXNET_KV_PAGED``.
+    page_tokens, pool_pages, prefill_chunk : int, optional
+        Paged-mode knobs; default to ``MXNET_KV_PAGE_TOKENS`` /
+        ``MXNET_KV_POOL_PAGES`` / ``MXNET_PREFILL_CHUNK``.
+        ``cache_len`` must divide by ``page_tokens`` (the table ring-mods
+        over ``cache_len // page_tokens`` entries, so paged results stay
+        bit-parity with a dense ring of the same capacity).
+    prefix_cache : bool
+        Arm copy-on-write prefix sharing in paged mode (default on).
     """
 
     def __init__(self, symbol, params, cache_len, ctx=None, mesh=None,
-                 temperature=0.0, top_k=0, data_name="data", kv_dtype=None):
+                 temperature=0.0, top_k=0, data_name="data", kv_dtype=None,
+                 paged=None, page_tokens=None, pool_pages=None,
+                 prefill_chunk=None, prefix_cache=True):
         import jax
         import jax.numpy as jnp
 
@@ -168,6 +207,29 @@ class DecodePredictor:
             self._kv_dtype = jnp.dtype(canonical)
         else:
             self._kv_dtype = None
+
+        # an explicit paged= argument outranks the ambient env var (a
+        # deliberately dense predictor under MXNET_KV_PAGED=1 — e.g. a
+        # draft model — must not read as a dropped-plumbing regression)
+        self._paged_from_env = paged is None
+        if paged is None:
+            paged = _config.get("MXNET_KV_PAGED")
+        self._paged = bool(paged)
+        self._prefix_cache_on = bool(prefix_cache)
+        self._page_tokens = int(page_tokens) if page_tokens \
+            else int(_config.get("MXNET_KV_PAGE_TOKENS"))
+        self._pool_pages = int(pool_pages) if pool_pages \
+            else int(_config.get("MXNET_KV_POOL_PAGES"))
+        self._prefill_chunk = int(prefill_chunk) if prefill_chunk \
+            else int(_config.get("MXNET_PREFILL_CHUNK"))
+        if self._paged:
+            if self._page_tokens <= 0:
+                raise MXNetError("page_tokens must be positive")
+            if self._cache_len % self._page_tokens:
+                raise MXNetError(
+                    "cache_len %d is not a multiple of page_tokens %d — "
+                    "paged capacity must tile into whole pages"
+                    % (self._cache_len, self._page_tokens))
 
         arg_params, aux_params = _as_param_dicts(params)
         free = [n for n in symbol.list_arguments() if n not in arg_params]
@@ -227,10 +289,33 @@ class DecodePredictor:
         # each trace ONCE, prefill once per admitted (B, P) shape.
         # Probes (lowering for artifact/FLOP text) set _probing and don't
         # count.
-        self.trace_counts = {"prefill": 0, "decode": 0, "verify": 0}
+        self.trace_counts = {"prefill": 0, "decode": 0, "verify": 0,
+                             "chunk": 0, "fork": 0, "commit": 0}
         self._probing = False
-        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=donate)
-        self._verify_fn = jax.jit(self._verify_impl, donate_argnums=donate)
+        if self._paged:
+            # paged programs take (page tables, active mask) as DATA; the
+            # chunk program is the whole prefill story (one fixed width)
+            self._decode_fn = jax.jit(self._paged_decode_impl,
+                                      donate_argnums=donate)
+            self._verify_fn = jax.jit(self._paged_verify_impl,
+                                      donate_argnums=donate)
+            half = (1,) if self._donate else ()
+            self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=half)
+            self._fork_fn = jax.jit(
+                self._fork_impl,
+                donate_argnums=(0,) if self._donate else ())
+            self._commit_fn = jax.jit(
+                self._commit_impl,
+                donate_argnums=(0, 1) if self._donate else ())
+            self._manager = None          # serve.PagedKVManager, per batch
+            self._pools_template = None   # per-node cache avals (probed)
+            self._paged_lens = None       # host mirror for standalone use
+            self._chunk_widths = set()    # distinct chunk widths driven
+        else:
+            self._decode_fn = jax.jit(self._decode_impl,
+                                      donate_argnums=donate)
+            self._verify_fn = jax.jit(self._verify_impl,
+                                      donate_argnums=donate)
         self._verify_shapes = set()   # distinct (B, k, has_q) driven
         self._prefill_fns = {}   # (B, P) -> jitted prefill program
         # jnp dummies reused every call (sample_tokens at temperature 0
@@ -244,13 +329,18 @@ class DecodePredictor:
     # ------------------------------------------------------------------
     # the shared graph walk (traced inside both programs)
     # ------------------------------------------------------------------
-    def _run(self, env, tokens, caches, pos0):
+    def _run(self, env, tokens, caches, pos0, tables=None, active=None,
+             valid=None):
         """Execute the symbol on (B, t) tokens.
 
         ``caches is None`` = prefill mode: full causal attention, fresh
         ring buffers captured from each attention node's K/V.  Otherwise
         decode mode: append K/V at ``pos0`` (per-sequence), length-masked
-        attention against the cache.  Returns ``(probs (B, t, V),
+        attention against the cache.  With ``tables`` given the caches
+        are shared page pools: appends scatter through the per-slot page
+        tables (``active``/``valid`` masks redirect non-writes to the
+        scratch page) and attention runs over the gathered dense-ring
+        view — same numerics, paged storage.  Returns ``(probs (B, t, V),
         caches)``.
         """
         import jax
@@ -292,12 +382,25 @@ class DecodePredictor:
                 else:
                     kc, vc = caches[ci]
                     ci += 1
-                    kc = _attn.cache_append(kc, k, pos0, num_heads=heads)
-                    vc = _attn.cache_append(vc, v, pos0, num_heads=heads)
+                    if tables is not None:
+                        kc = _attn.paged_append(kc, tables, k, pos0,
+                                                num_heads=heads,
+                                                active=active, valid=valid)
+                        vc = _attn.paged_append(vc, tables, v, pos0,
+                                                num_heads=heads,
+                                                active=active, valid=valid)
+                        kview = _attn.paged_gather(kc, tables)
+                        vview = _attn.paged_gather(vc, tables)
+                    else:
+                        kc = _attn.cache_append(kc, k, pos0,
+                                                num_heads=heads)
+                        vc = _attn.cache_append(vc, v, pos0,
+                                                num_heads=heads)
+                        kview, vview = kc, vc
                     pos = jnp.asarray(pos0, jnp.int32).reshape(-1)
                     sdpa_cached = _attn.sdpa_decode if t == 1 \
                         else _attn.sdpa_verify
-                    outs = [sdpa_cached(q, kc, vc, pos + t,
+                    outs = [sdpa_cached(q, kview, vview, pos + t,
                                         num_heads=heads, scale=scale)]
                     new_caches.append((kc, vc))
             else:
@@ -355,14 +458,16 @@ class DecodePredictor:
         buf = jax.lax.dynamic_update_slice(buf, x, (0, 0, 0))
         if self._kv_dtype is not None:
             q = _attn.quantize_kv(buf, self._kv_dtype, num_heads)
-            if self._cache_sharding is not None:
+            # _probing also covers the paged shape probe: an eval_shape at
+            # B=1 must not trip a batch-axis divisibility check
+            if self._cache_sharding is not None and not self._probing:
                 q = _attn.QuantKV(
                     jax.lax.with_sharding_constraint(q.data,
                                                      self._cache_sharding),
                     jax.lax.with_sharding_constraint(
                         q.scale, self._scale_sharding(num_heads)))
             return q
-        if self._cache_sharding is not None:
+        if self._cache_sharding is not None and not self._probing:
             buf = jax.lax.with_sharding_constraint(buf, self._cache_sharding)
         return buf
 
@@ -461,6 +566,318 @@ class DecodePredictor:
         return (DecodeState(caches, state.lens + counts, tok), out, counts)
 
     # ------------------------------------------------------------------
+    # paged mode — the same programs over shared page pools; page tables
+    # and active masks ride in as DATA (mxnet_tpu.serve decides, these
+    # execute)
+    # ------------------------------------------------------------------
+    def _paged_decode_impl(self, env, state, tables, active, key):
+        """One paged decode step at fixed batch shape.  ``active`` (B,)
+        0/1 gates rows that are empty or mid-chunked-prefill: their
+        appends redirect to the scratch page and their lens/tok are
+        preserved, so one traced program carries every batch occupancy."""
+        import jax.numpy as jnp
+
+        if not self._probing:
+            self.trace_counts["decode"] += 1
+        probs3, caches = self._run(env, state.tok, state.caches, state.lens,
+                                   tables=tables, active=active)
+        probs = probs3[:, 0]
+        tok = self._sample(key, probs)
+        act = jnp.asarray(active).reshape(-1, 1).astype(bool)
+        tok = jnp.where(act, tok, state.tok)
+        lens = state.lens + jnp.asarray(active, jnp.int32).reshape(-1)
+        return DecodeState(caches, lens, tok), probs
+
+    def _paged_verify_impl(self, env, state, tables, active, draft_toks,
+                           draft_probs, key):
+        """Speculative verify over page tables — same acceptance rule as
+        the dense :meth:`_verify_impl`, appends scattered through the
+        tables, inactive rows commit zero tokens."""
+        import jax.numpy as jnp
+
+        from .ops.sample import speculative_accept
+
+        if not self._probing:
+            self.trace_counts["verify"] += 1
+        toks_in = jnp.concatenate(
+            [state.tok.astype(jnp.int32), draft_toks.astype(jnp.int32)],
+            axis=1)
+        probs3, caches = self._run(env, toks_in, state.caches, state.lens,
+                                   tables=tables, active=active)
+        pi = probs3 if self._greedy else self._policy_probs(probs3)
+        counts, out = speculative_accept(key, pi, draft_toks, draft_probs,
+                                         greedy=self._greedy)
+        act = jnp.asarray(active).reshape(-1).astype(bool)
+        counts = jnp.where(act, counts, 0)
+        k = draft_toks.shape[1]
+        tok = jnp.take_along_axis(
+            out, jnp.clip(counts - 1, 0, k)[:, None], axis=1)
+        tok = jnp.where(act[:, None], tok, state.tok)
+        return (DecodeState(caches, state.lens + counts, tok), out, counts)
+
+    def _chunk_impl(self, env, caches, table1, toks, pos0, nvalid, key):
+        """One fixed-width prefill chunk for a single slot: append the
+        chunk's K/V at positions [pos0, pos0 + nvalid) of the slot's page
+        table (pad positions past ``nvalid`` are never written), attend
+        causally against everything cached so far, and sample at the
+        chunk's last real position.  The final chunk's sample IS the
+        request's first token; earlier chunks' samples are discarded.
+        One trace per chunk width — chunked prefill never retraces."""
+        import jax.numpy as jnp
+
+        if not self._probing:
+            self.trace_counts["chunk"] += 1
+        ones = jnp.ones((toks.shape[0],), jnp.int32)
+        probs3, caches = self._run(env, toks, caches, pos0, tables=table1,
+                                   active=ones, valid=nvalid)
+        last = jnp.clip(jnp.asarray(nvalid, jnp.int32) - 1, 0,
+                        toks.shape[1] - 1)
+        probs = jnp.take_along_axis(
+            probs3, last[:, None, None], axis=1)[:, 0]
+        tok = self._sample(key, probs)
+        return caches, probs, tok
+
+    def _fork_impl(self, caches, src, dst):
+        """Copy-on-write fork: duplicate page ``src`` into ``dst`` across
+        every pool (page ids are one global space).  Traced once — the
+        ids are data."""
+        import jax.tree_util as jtu
+
+        if not self._probing:
+            self.trace_counts["fork"] += 1
+        return jtu.tree_map(lambda pool: pool.at[dst].set(pool[src]),
+                            caches)
+
+    def _commit_impl(self, lens, tok, slot, new_len, new_tok):
+        """Activate a freshly prefilled slot: splice its prompt length and
+        first token into the batch state (traced slot index)."""
+        import jax
+
+        if not self._probing:
+            self.trace_counts["commit"] += 1
+        import jax.numpy as jnp
+
+        lens = jax.lax.dynamic_update_slice(lens, new_len, (slot,))
+        tok = jax.lax.dynamic_update_slice(tok, new_tok,
+                                           (slot, jnp.int32(0)))
+        return lens, tok
+
+    def _probe_cache_shapes(self):
+        """Per-attention-node cache avals — (1, C, E) K/V (or QuantKV)
+        from an abstract prefill at (1, 1), the shape source for building
+        page pools without running a dense prefill."""
+        import jax
+        import jax.numpy as jnp
+
+        env = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for n, v in self._env.items()}
+        toks = jax.ShapeDtypeStruct((1, 1), jnp.float32)
+        self._probing = True
+        try:
+            return jax.eval_shape(
+                lambda e, t: self._run(e, t, None, 0)[1], env, toks)
+        finally:
+            self._probing = False
+
+    def _place_pool(self, buf, is_scale=False):
+        """Mesh placement for a (P, page_tokens, E|H) pool: heads shard
+        on 'model' (``tp_rules.kv_pool_pspec``), page dim replicated; a
+        scale plane whose H does not divide the model axis replicates
+        (same degrade rule as the dense :meth:`_scale_sharding`)."""
+        import jax
+
+        if self._mesh is None:
+            return jax.device_put(buf, self._ctx.jax_device)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .parallel.tp_rules import kv_pool_pspec
+
+        spec = kv_pool_pspec(self._mesh.shape)
+        if spec[2] is not None and \
+                buf.shape[2] % dict(self._mesh.shape)[spec[2]] != 0:
+            spec = P(None, None, None)
+        return jax.device_put(buf, NamedSharding(self._mesh, spec))
+
+    def paged_batch_state(self, slots):
+        """Fresh paged serving state over ``slots`` slots: a new
+        :class:`~mxnet_tpu.serve.PagedKVManager` (allocator + prefix
+        cache + page tables) and zeroed pools.  Pool shapes depend only
+        on (pool_pages, page_tokens, E), so repeated batches at one
+        sizing reuse every compiled program."""
+        import jax.numpy as jnp
+
+        from .ops.attention import QuantKV
+        from .serve import PagedKVManager
+
+        self._manager = PagedKVManager(
+            slots, self._cache_len, self._page_tokens,
+            pool_pages=self._pool_pages,
+            prefix_cache=self._prefix_cache_on)
+        if self._pools_template is None:
+            self._pools_template = self._probe_cache_shapes()
+        pp = self._manager.pool_pages
+        pt = self._page_tokens
+
+        def pool_of(aval, is_scale=False):
+            return self._place_pool(
+                jnp.zeros((pp, pt, aval.shape[2]), aval.dtype),
+                is_scale=is_scale)
+
+        pools = []
+        for kc, vc in self._pools_template:
+            pair = []
+            for aval in (kc, vc):
+                if isinstance(aval, QuantKV):
+                    pair.append(QuantKV(pool_of(aval.data),
+                                        pool_of(aval.scale, is_scale=True)))
+                else:
+                    pair.append(pool_of(aval))
+            pools.append(tuple(pair))
+        self._paged_lens = np.zeros(slots, np.int64)
+        return DecodeState(tuple(pools), jnp.zeros((slots,), jnp.int32),
+                           jnp.zeros((slots, 1), jnp.int32))
+
+    def pool_bytes(self):
+        """Static bytes of the shared page pools — the paged serving HBM
+        bill (what ``tokens_per_sec_per_gb`` divides by), sized through
+        the same width table as :meth:`cache_bytes`."""
+        import jax.tree_util as jtu
+
+        from .analysis.hlo_parse import shape_bytes, shape_str
+
+        if self._manager is None:
+            raise MXNetError("pool_bytes before any paged prefill/serve")
+        if self._pools_template is None:
+            self._pools_template = self._probe_cache_shapes()
+        pp, pt = self._manager.pool_pages, self._page_tokens
+        return sum(shape_bytes(shape_str((pp, pt, aval.shape[2]),
+                                         aval.dtype))
+                   for aval in jtu.tree_leaves(self._pools_template))
+
+    def _run_forks(self, caches, copies):
+        """Execute a manager-planned list of (src, dst) page copies —
+        copy-on-write forks — before the append step that needs them."""
+        import jax.numpy as jnp
+
+        for src, dst in copies:
+            caches = self._fork_fn(caches, jnp.int32(src), jnp.int32(dst))
+        return caches
+
+    def paged_prepare(self, state, lens_h, width, active=None):
+        """Make positions [lens, lens + width) of every active row
+        writable (allocate/fork through the manager, run the forks) and
+        return ``(state', tables, active)`` ready for the step."""
+        import jax.numpy as jnp
+
+        mgr = self._manager
+        act = np.ones(mgr.slots, np.int32) if active is None \
+            else np.asarray(active).astype(np.int32).reshape(-1)
+        caches = state.caches
+        for s in range(mgr.slots):
+            if act[s]:
+                copies = mgr.ensure(s, int(lens_h[s]),
+                                    int(lens_h[s]) + int(width))
+                if copies:
+                    caches = self._run_forks(caches, copies)
+        return (DecodeState(caches, state.lens, state.tok),
+                jnp.asarray(mgr.tables), jnp.asarray(act))
+
+    def paged_step(self, state, lens_h, key=None, active=None):
+        """One paged decode step: ensure pages, run forks, step.  The
+        caller owns the host length vector (``lens_h``) and advances it
+        by the returned activity."""
+        state, tables, act = self.paged_prepare(state, lens_h, 1, active)
+        return self._decode_fn(self._env, state, tables, act,
+                               key if key is not None else self._zero_key)
+
+    def paged_verify(self, state, lens_h, draft_toks, draft_probs=None,
+                     key=None, active=None):
+        """One paged speculative macro-step (see :meth:`verify_step`)."""
+        import jax.numpy as jnp
+
+        draft_toks = jnp.asarray(draft_toks, jnp.int32)
+        k = draft_toks.shape[1]
+        state, tables, act = self.paged_prepare(state, lens_h, k + 1,
+                                                active)
+        self._verify_shapes.add((draft_toks.shape[0], int(k),
+                                 draft_probs is not None))
+        return self._verify_fn(self._env, state, tables, act, draft_toks,
+                               draft_probs,
+                               key if key is not None else self._zero_key)
+
+    def _paged_prefill(self, tokens, prompt_len=None, key=None):
+        """Paged prefill = chunked cached-forward, one row at a time:
+        match the prefix cache, map shared pages, compute only the tail
+        through the chunk program, publish the prompt's pages.  Resets
+        the page bookkeeping for a fresh (B,)-slot batch."""
+        import jax
+        import jax.numpy as jnp
+
+        tokens = np.asarray(tokens)
+        b, p = tokens.shape
+        if p > self._cache_len:
+            raise MXNetError("prompt width %d exceeds cache_len %d"
+                             % (p, self._cache_len))
+        if prompt_len is None:
+            prompt_len = p
+        lens_h = np.broadcast_to(
+            np.asarray(prompt_len, np.int64).reshape(-1), (b,)).copy()
+        state = self.paged_batch_state(b)
+        mgr = self._manager
+        key = key if key is not None else self._zero_key
+        caches = state.caches
+        toks_out, probs_out = [], []
+        for row in range(b):
+            prompt = tokens[row, :int(lens_h[row])].astype(np.int64)
+            gate = mgr.gate(prompt, prompt.size, self._cache_len,
+                            budget_wrap_forks=False)
+            if gate is None:
+                raise MXNetError(
+                    "KV page pool cannot admit a %d-token prompt — raise "
+                    "MXNET_KV_POOL_PAGES (pool: %d pages)"
+                    % (prompt.size, mgr.pool_pages))
+            matched, pages, reserve_n = gate
+            mgr.map_slot(row, pages, reserve_n)
+            caches, tok, probs = self._chunked_fill(
+                caches, row, prompt, matched, jax.random.fold_in(key, row))
+            mgr.publish(row, prompt, prompt.size)
+            toks_out.append(tok)
+            probs_out.append(probs)
+        self._paged_lens = lens_h
+        state = DecodeState(caches, jnp.asarray(lens_h, jnp.int32),
+                            jnp.concatenate(toks_out, axis=0))
+        return state, jnp.concatenate(probs_out, axis=0)
+
+    def _chunked_fill(self, caches, slot, prompt, start, key, width=None):
+        """Run [start, len(prompt)) of one row's prompt through the chunk
+        program in fixed-width windows; returns (caches, first-token,
+        first-token probs) from the final chunk."""
+        import jax
+        import jax.numpy as jnp
+
+        mgr = self._manager
+        total = int(prompt.size)
+        w = int(width or self._prefill_chunk or (total - int(start)))
+        w = max(1, min(w, self._cache_len))
+        self._chunk_widths.add(w)
+        pos = int(start)
+        tok = probs = None
+        while pos < total:
+            n = min(w, total - pos)
+            copies = mgr.ensure(slot, pos, pos + n)
+            if copies:
+                caches = self._run_forks(caches, copies)
+            key, sub = jax.random.split(key)
+            caches, probs, tok = self._chunk_fn(
+                self._env, caches,
+                jnp.asarray(mgr.tables[slot:slot + 1]),
+                jnp.asarray(_pad_window(prompt[pos:pos + n], w)),
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray([n], jnp.int32), sub)
+            pos += n
+        return caches, tok, probs
+
+    # ------------------------------------------------------------------
     # public surface
     # ------------------------------------------------------------------
     def prefill(self, tokens, prompt_len=None, key=None):
@@ -471,11 +888,15 @@ class DecodePredictor:
         overwrites them.  ``probs`` is the model's (B, V) output at each
         row's last real position; ``state.tok`` the sampled first token.
         Jitted per (B, P) shape; repeated calls at one shape reuse the
-        compiled program (the serving loop's fixed-shape prefill).
+        compiled program (the serving loop's fixed-shape prefill).  In
+        paged mode this is chunked prefill over fresh page tables (one
+        slot per row, prefix cache consulted per row).
         """
         import jax
         import jax.numpy as jnp
 
+        if self._paged:
+            return self._paged_prefill(tokens, prompt_len, key)
         tokens = self._place_tokens(tokens)
         b, p = tokens.shape
         if p > self._cache_len:
@@ -503,6 +924,10 @@ class DecodePredictor:
         the new ``state'.tok`` was drawn from.  The input state is donated
         (``MXNET_DECODE_DONATE``) — do not reuse it after the call.
         """
+        if self._paged:
+            out = self.paged_step(state, self._paged_lens, key)
+            self._paged_lens += 1
+            return out
         return self._decode_fn(self._env, state,
                                key if key is not None else self._zero_key)
 
@@ -526,6 +951,11 @@ class DecodePredictor:
         """
         import jax.numpy as jnp
 
+        if self._paged:
+            st, out, counts = self.paged_verify(
+                state, self._paged_lens, draft_toks, draft_probs, key)
+            self._paged_lens += np.asarray(counts, np.int64)
+            return st, out, counts
         draft_toks = jnp.asarray(draft_toks, jnp.int32)
         self._verify_shapes.add((draft_toks.shape[0], draft_toks.shape[1],
                                  draft_probs is not None))
@@ -667,15 +1097,32 @@ class DecodePredictor:
             tokens = np.asarray(tokens, np.float32)
         return jax.device_put(tokens, self._token_sharding)
 
+    def _paged_probe_args(self, state):
+        """Concrete (tables, active) matching this state's batch — the
+        extra decode/verify operands in paged mode."""
+        import jax.numpy as jnp
+
+        b = state.lens.shape[0]
+        m = self._cache_len // self._page_tokens
+        if self._manager is not None and self._manager.slots == b:
+            tables = jnp.asarray(self._manager.tables)
+        else:
+            tables = jnp.zeros((b, m), jnp.int32)
+        return tables, jnp.ones((b,), jnp.int32)
+
     def decode_step_text(self, state, key=None):
         """Lowered (pre-optimization) StableHLO of the decode-step program
         at this state's shapes — feed to ``parallel.hlo_stats.dot_flops``
         for the O(1)-in-prefix FLOP assertion (bench_decode.py)."""
+        key = key if key is not None else self._zero_key
         self._probing = True
         try:
+            if self._paged:
+                tables, active = self._paged_probe_args(state)
+                return self._decode_fn.lower(
+                    self._env, state, tables, active, key).as_text()
             return self._decode_fn.lower(
-                self._env, state,
-                key if key is not None else self._zero_key).as_text()
+                self._env, state, key).as_text()
         finally:
             self._probing = False
 
@@ -696,6 +1143,10 @@ class DecodePredictor:
         recompute-the-prefix cost baseline for the FLOP assertion."""
         import jax
 
+        if self._paged:
+            raise MXNetError("paged mode prefills through the chunk "
+                             "program; there is no one-shot prefill "
+                             "program to probe")
         fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
         self._probing = True
         try:
@@ -712,6 +1163,10 @@ class DecodePredictor:
 
         from .analysis.artifact import artifact_from_jit
 
+        if self._paged:
+            raise MXNetError("paged mode prefills through the chunk "
+                             "program; there is no one-shot prefill "
+                             "program to snapshot")
         fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
         count = self.trace_counts["prefill"]
         expected = max(len(self._prefill_fns), 1)
@@ -742,7 +1197,10 @@ class DecodePredictor:
     def _cache_meta(self, state):
         """Cache metadata for artifacts: the static byte budget plus the
         DATA dtypes actually stored (the cache-bytes pass flags an f32
-        data plane inside a quantized config from these)."""
+        data plane inside a quantized config from these) and the cache
+        layout (the pass flags a dense-ring allocation under a paged
+        config — the memory-manager plumbing was dropped)."""
+        from . import config as _config
         from .ops.attention import QuantKV
 
         dtypes = set()
@@ -750,10 +1208,19 @@ class DecodePredictor:
             for c in (kc, vc):
                 dtypes.add(str((c.data if isinstance(c, QuantKV)
                                 else c).dtype))
-        return {"cache_bytes": self.cache_bytes(state),
+        meta = {"cache_bytes": self.cache_bytes(state),
                 "kv_dtype": str(self._kv_dtype)
                 if self._kv_dtype is not None else None,
-                "cache_data_dtypes": sorted(dtypes)}
+                "cache_data_dtypes": sorted(dtypes),
+                "cache_layout": "paged" if self._paged else "dense",
+                "kv_paged": bool(self._paged or (
+                    self._paged_from_env
+                    and _config.get("MXNET_KV_PAGED")))}
+        if self._paged:
+            meta["page_tokens"] = self._page_tokens
+            if self._manager is not None:
+                meta["pool_pages"] = self._manager.pool_pages
+        return meta
 
     def decode_artifact(self, state, key=None, name="decode_step"):
         """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
@@ -772,8 +1239,13 @@ class DecodePredictor:
         count = self.trace_counts["decode"]
         self._probing = True
         try:
+            if self._paged:
+                tables, active = self._paged_probe_args(state)
+                args = (env, astate, _aval(tables), _aval(active), akey)
+            else:
+                args = (env, astate, akey)
             return artifact_from_jit(
-                self._decode_fn, (env, astate, akey), name=name,
+                self._decode_fn, args, name=name,
                 donated_leaves=donated,
                 mesh_shape=dict(self._mesh.shape)
                 if self._mesh is not None else None,
@@ -809,8 +1281,14 @@ class DecodePredictor:
         expected = max(len(self._verify_shapes), 1)
         self._probing = True
         try:
+            if self._paged:
+                tables, active = self._paged_probe_args(state)
+                args = (env, astate, _aval(tables), _aval(active), atoks,
+                        aq, akey)
+            else:
+                args = (env, astate, atoks, aq, akey)
             return artifact_from_jit(
-                self._verify_fn, (env, astate, atoks, aq, akey), name=name,
+                self._verify_fn, args, name=name,
                 donated_leaves=donated,
                 mesh_shape=dict(self._mesh.shape)
                 if self._mesh is not None else None,
@@ -939,6 +1417,12 @@ class DraftProposer:
 
     def __init__(self, predictor, k):
         self._pred = predictor
+        if getattr(predictor, "_paged", False):
+            raise MXNetError(
+                "DraftProposer needs a dense-cache DecodePredictor: the "
+                "draft's per-admission prefill would reset a paged "
+                "predictor's page bookkeeping (drafts are small — dense "
+                "ring buffers cost them little)")
         self.k = int(k)
         if self.k <= 0:
             raise MXNetError("DraftProposer k must be positive")
@@ -1063,6 +1547,13 @@ class DecodeServer:
         self._queue = deque()
         self._next_id = 0
         self._insert_fn = None
+        self._req = {}          # rid -> submit/admit/first/retire times
+        self._done_rids = deque()   # retired rids, oldest first (pruning)
+        # chunked-prefill width (paged mode): the predictor's configured
+        # chunk, clamped to the admission window — ONE width, one trace
+        self._chunk_w = min(
+            int(getattr(predictor, "_prefill_chunk", 0) or max_prefill),
+            int(max_prefill))
         # --- speculative decoding (MXNET_SPEC_K / explicit args) ---
         if spec_k is None:
             spec_k = int(_config.get("MXNET_SPEC_K"))
@@ -1103,7 +1594,93 @@ class DecodeServer:
         cap = int(max_new_tokens) if max_new_tokens is not None \
             else self._max_new
         self._queue.append((rid, tokens, cap))
+        self._req[rid] = {"submit": time.time()}
         return rid
+
+    # retained retired-request records (stats percentiles); older ones
+    # are pruned so a long-lived server cannot grow without bound (the
+    # profiler-side store has the same cap)
+    _REQ_CAP = 4096
+
+    def _finish(self, rid, ntokens):
+        """Close a request's SLO record and publish it to the profiler
+        (queue wait, time to first token, decode tokens/s)."""
+        from . import profiler as _prof
+
+        rec = self._req.get(rid)
+        if rec is None or "retire" in rec:
+            return
+        now = time.time()
+        rec["retire"] = now
+        rec["tokens"] = int(ntokens)
+        first = rec.get("first", now)
+        _prof.record_request(
+            rec.get("admit", rec["submit"]) - rec["submit"],
+            first - rec["submit"], ntokens, now - first)
+        self._done_rids.append(rid)
+        while len(self._done_rids) > self._REQ_CAP:
+            self._req.pop(self._done_rids.popleft(), None)
+
+    def _deliver(self, rec, emitted):
+        """Append a window of emitted tokens to a request, honoring its
+        cap and retiring at an EOS inside the window (shared by the
+        dense and paged loops — ONE copy of the retirement rule)."""
+        _, toks, max_new = rec
+        for t in emitted:
+            if len(toks) >= max_new:
+                break
+            toks.append(int(t))
+            if self._eos_id is not None and t == self._eos_id:
+                break
+
+    def _retire_finished(self, active, results, on_retire=None):
+        """Retire every finished request in ``active`` (EOS delivered or
+        cap reached): record the result, close its SLO record, free the
+        slot — plus ``on_retire(slot)`` for loop-specific cleanup (the
+        paged loop frees the slot's pages here, immediately)."""
+        for slot in list(active):
+            rid, toks, max_new = active[slot]
+            if (self._eos_id is not None and toks
+                    and toks[-1] == self._eos_id) \
+                    or len(toks) >= max_new:
+                results[rid] = np.asarray(toks, np.int32)
+                self.tokens_out += len(toks)
+                self._finish(rid, len(toks))
+                del active[slot]
+                if on_retire is not None:
+                    on_retire(slot)
+
+    def stats(self):
+        """Serving-side SLO snapshot: loop counters, per-request
+        percentiles (queue wait, TTFT, decode tokens/s) and — in paged
+        mode — pool utilization and prefix-cache hit accounting."""
+        from .profiler import _percentile
+
+        done = [r for r in self._req.values() if "retire" in r]
+        out = {"steps": self.steps, "spec_steps": self.spec_steps,
+               "tokens_out": self.tokens_out,
+               "accept_rate": self.accept_rate,
+               "requests_completed": len(done),
+               "requests_queued": len(self._queue)}
+        if done:
+            qw = sorted(r.get("admit", r["submit"]) - r["submit"]
+                        for r in done)
+            tf = sorted(r.get("first", r["retire"]) - r["submit"]
+                        for r in done)
+            out["queue_wait_p50_s"] = _percentile(qw, 0.50)
+            out["queue_wait_p95_s"] = _percentile(qw, 0.95)
+            out["ttft_p50_s"] = _percentile(tf, 0.50)
+            out["ttft_p95_s"] = _percentile(tf, 0.95)
+            rates = sorted(
+                (r["tokens"] - 1)
+                / max(r["retire"] - r.get("first", r["retire"]), 1e-9)
+                for r in done if r["tokens"] > 1)
+            if rates:
+                out["decode_tokens_per_sec_p50"] = _percentile(rates, 0.50)
+        if getattr(self._pred, "_paged", False) \
+                and self._pred._manager is not None:
+            out.update(self._pred._manager.stats())
+        return out
 
     def run(self):
         """Drain the queue; returns ``{request_id: np.int32 array}`` of
@@ -1118,9 +1695,17 @@ class DecodeServer:
         refills before the next step.  Near the ring-wrap boundary the
         loop falls back to plain single-token steps (both programs
         already traced — still zero retraces).
+
+        With a paged predictor the loop instead drives the page-managed
+        schedule (:meth:`_run_paged`): prompts admit in fixed-size chunks
+        interleaved with decode steps, prefix-cache hits skip their
+        matched pages' prefill, copy-on-write forks run before divergent
+        writes, and retirement frees pages immediately.
         """
         import jax
 
+        if getattr(self._pred, "_paged", False):
+            return self._run_paged()
         key = jax.random.PRNGKey(self._seed)
         state = None
         active = {}     # slot -> [rid, tokens list, max_new]
@@ -1136,34 +1721,19 @@ class DecodeServer:
             self._insert_fn = _build_insert_fn()
 
         def retire():
-            for slot in list(active):
-                rid, toks, max_new = active[slot]
-                if (self._eos_id is not None and toks
-                        and toks[-1] == self._eos_id) \
-                        or len(toks) >= max_new:
-                    results[rid] = np.asarray(toks, np.int32)
-                    self.tokens_out += len(toks)
-                    del active[slot]
+            self._retire_finished(active, results)
 
-        def deliver(rec, emitted):
-            """Append a window of emitted tokens to a request, honoring
-            its cap and retiring at an EOS inside the window."""
-            _, toks, max_new = rec
-            for t in emitted:
-                if len(toks) >= max_new:
-                    break
-                toks.append(int(t))
-                if self._eos_id is not None and t == self._eos_id:
-                    break
+        deliver = self._deliver
 
         while self._queue or active:
             # admit: prefill one request per free slot, splice into batch
             while self._queue and len(active) < self._slots:
                 rid, prompt, max_new = self._queue.popleft()
-                padded = np.zeros((1, self._max_prefill), np.float32)
-                padded[0, :prompt.size] = prompt
+                padded = _pad_window(prompt, self._max_prefill)
                 key, sub = jax.random.split(key)
                 one, _ = self._pred.prefill(padded, prompt.size, sub)
+                rec = self._req[rid]
+                rec["admit"] = rec["first"] = time.time()
                 slot = next(s for s in range(self._slots)
                             if s not in active)
                 if state is None:
@@ -1210,5 +1780,160 @@ class DecodeServer:
                     deliver(rec, toks[slot:slot + 1])
                     histories[slot].append(int(toks[slot]))
                 slot_lens += 1
+            retire()
+        return results
+
+    def _run_paged(self):
+        """The paged serving schedule.
+
+        Each iteration: (1) gate at most one queued request through the
+        page allocator — reservation failure is BACKPRESSURE, the request
+        stays queued until retirements free pages; (2) advance the
+        in-flight admission by ONE prefill chunk (prefix-cache-matched
+        pages were mapped at the gate, only the tail computes), so a long
+        prompt interleaves with decode instead of stalling the batch;
+        (3) on the final chunk, splice the first token/length into the
+        batch state, publish the prompt's pages to the prefix cache and
+        activate the slot; (4) retire finished requests — freeing their
+        pages IMMEDIATELY, EOS-mid-speculation-window included; (5) run
+        one decode (or speculative verify) step over the active slots,
+        inactive rows masked.  Every device program here was traced
+        once — page tables, active masks, slot indices and page ids are
+        all data.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        pred = self._pred
+        key = jax.random.PRNGKey(self._seed)
+        slots = self._slots
+        state = pred.paged_batch_state(slots)
+        mgr = pred._manager
+        active = {}     # slot -> [rid, tokens list, max_new]
+        results = {}
+        histories = {}
+        slot_lens = np.zeros(slots, np.int64)
+        act_mask = np.zeros(slots, np.int32)
+        pending = None  # the one admission mid-chunked-prefill
+        proposer = self._proposer
+        k = self._spec_k
+        limit = pred.cache_len
+        if proposer is not None and getattr(proposer, "cache_len", None):
+            limit = min(limit, proposer.cache_len + 1)
+
+        def on_retire(slot):
+            act_mask[slot] = 0
+            # pages back to the pool NOW — the very next admission
+            # gate sees them (not "at next admission")
+            mgr.free_slot(slot)
+
+        def retire():
+            self._retire_finished(active, results, on_retire)
+
+        deliver = self._deliver
+
+        def try_admit():
+            rid, prompt, cap = self._queue[0]
+            gate = mgr.gate(prompt, prompt.size, cap, k)
+            if gate is None:
+                return None
+            self._queue.popleft()
+            matched, pages, reserve_n = gate
+            slot = next(s for s in range(slots) if s not in active)
+            mgr.map_slot(slot, pages, reserve_n)
+            self._req[rid]["admit"] = time.time()
+            return {"slot": slot, "rid": rid,
+                    "prompt": np.asarray(prompt).reshape(-1)
+                    .astype(np.int64), "cap": cap, "pos": int(matched)}
+
+        while self._queue or active or pending:
+            # --- (1) admission gate: one request starts prefilling
+            if pending is None and self._queue and len(active) < slots:
+                pending = try_admit()
+                if pending is None and not active:
+                    # nothing running to free pages: spill the whole
+                    # prefix cache, then the pool is genuinely too small
+                    if mgr.prefix_cache is not None:
+                        mgr.prefix_cache.evict(mgr.pool_pages)
+                        pending = try_admit()
+                    if pending is None:
+                        raise MXNetError(
+                            "KV page pool (%d pages) cannot admit a "
+                            "%d-token request even with an empty batch — "
+                            "raise MXNET_KV_POOL_PAGES"
+                            % (mgr.pool_pages, self._queue[0][1].size))
+            # --- (2) one prefill chunk of the in-flight admission
+            if pending is not None:
+                p = pending
+                n = min(self._chunk_w, p["prompt"].size - p["pos"])
+                copies = mgr.ensure(p["slot"], p["pos"], p["pos"] + n)
+                caches = pred._run_forks(state.caches, copies) \
+                    if copies else state.caches
+                key, sub = jax.random.split(key)
+                caches, probs, tok = pred._chunk_fn(
+                    pred._env, caches,
+                    jnp.asarray(mgr.tables[p["slot"]:p["slot"] + 1]),
+                    jnp.asarray(_pad_window(
+                        p["prompt"][p["pos"]:p["pos"] + n], self._chunk_w)),
+                    jnp.asarray([p["pos"]], jnp.int32),
+                    jnp.asarray([n], jnp.int32), sub)
+                state = DecodeState(caches, state.lens, state.tok)
+                p["pos"] += n
+                pred._chunk_widths.add(self._chunk_w)
+                if p["pos"] >= p["prompt"].size:
+                    # --- (3) commit: the slot joins the batch
+                    slot, plen = p["slot"], p["prompt"].size
+                    first = int(np.asarray(tok)[0, 0])
+                    lens2, tok2 = pred._commit_fn(
+                        state.lens, state.tok, np.int32(slot),
+                        jnp.asarray([plen], jnp.int32), tok)
+                    state = DecodeState(state.caches, lens2, tok2)
+                    mgr.publish(slot, p["prompt"], plen)
+                    if proposer is not None \
+                            and getattr(proposer, "needs_prefill", False):
+                        key, sub = jax.random.split(key)
+                        proposer.admit(
+                            _pad_window(p["prompt"], self._max_prefill),
+                            plen, slot, slots, sub)
+                    active[slot] = [p["rid"], [first], p["cap"]]
+                    histories[slot] = list(p["prompt"]) + [first]
+                    slot_lens[slot] = plen
+                    act_mask[slot] = 1
+                    self._req[p["rid"]]["first"] = time.time()
+                    pending = None
+                    retire()        # a first-token EOS / cap-1 request
+            if not active:
+                continue
+            # --- (5) one decode / verify step over the active slots
+            key, sub = jax.random.split(key)
+            can_spec = proposer is not None and k > 0 and pending is None \
+                and max(slot_lens[s] for s in active) + k + 1 <= limit
+            if can_spec:
+                hists = [histories.get(s) or [0] for s in range(slots)]
+                draft_toks, draft_probs = proposer.propose(
+                    hists, state, slot_lens, sub)
+                key, sub = jax.random.split(key)
+                state, out, counts = pred.paged_verify(
+                    state, slot_lens, draft_toks, draft_probs, sub,
+                    act_mask)
+                out_h = np.asarray(out)
+                counts_h = np.asarray(counts).astype(np.int64)
+                self.steps += 1
+                self.spec_steps += 1
+                for slot, rec in active.items():
+                    emitted = out_h[slot, :counts_h[slot]]
+                    self.proposed += k
+                    self.accepted += int(counts_h[slot]) - 1
+                    deliver(rec, emitted)
+                    histories[slot].extend(int(t) for t in emitted)
+                slot_lens += counts_h
+            else:
+                state, _ = pred.paged_step(state, slot_lens, sub, act_mask)
+                self.steps += 1
+                toks = np.asarray(state.tok)[:, 0]
+                for slot, rec in active.items():
+                    deliver(rec, toks[slot:slot + 1])
+                    histories[slot].append(int(toks[slot]))
+                slot_lens += act_mask.astype(np.int64)
             retire()
         return results

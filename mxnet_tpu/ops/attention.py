@@ -261,6 +261,108 @@ def sdpa_verify(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
     return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale)
 
 
+# ---------------------------------------------------------------------------
+# Paged mode — the KV pool is one device-resident buffer of fixed-size pages
+# per attention node (vLLM's PagedAttention memory plan, Kwon et al. SOSP
+# 2023), shared by every serving slot, and each slot carries a PAGE TABLE:
+# position p of a slot lives at pool[table[slot, (p // page_tokens) %
+# table_width], p % page_tokens].  The table is DATA, not shape — one traced
+# decode/verify/chunk program serves every page mapping (admissions, COW
+# forks, retirements never retrace).  Because the table indexes ring-mod over
+# its width, the gathered per-slot view is laid out exactly like a dense
+# ring buffer of capacity table_width * page_tokens, so sdpa_decode /
+# sdpa_verify's length masking (including wrap) applies unchanged and paged
+# results are bit-parity with a dense ring of the same capacity.  Page id 0
+# is reserved as a scratch page: unmapped table entries point at it (their
+# slots are masked anyway) and writes of inactive rows are redirected into
+# it, which is what lets one fixed-shape batched program carry slots that
+# are empty or mid-prefill.  The host side (allocator, refcounts,
+# copy-on-write prefix sharing) lives in mxnet_tpu/serve/.
+# ---------------------------------------------------------------------------
+
+def paged_gather(pool, table):
+    """Gather a per-slot dense-ring view out of the shared page pool.
+
+    ``pool`` is (P, page_tokens, E) (or :class:`QuantKV` of pools);
+    ``table`` is (B, M) int32 page ids.  Returns the (B, M*page_tokens, E)
+    view whose index ``v`` holds the slot's position ``p`` with
+    ``v == p % (M*page_tokens)`` — the dense ring layout, so the cached
+    attention kernels mask it exactly like a ring buffer.  Unmapped table
+    entries (id 0, the scratch page) gather garbage into slots the length
+    mask already hides."""
+    if isinstance(pool, QuantKV):
+        return QuantKV(paged_gather(pool.data, table),
+                       paged_gather(pool.scale, table))
+    b, m = table.shape
+    pages = pool[table]                       # (B, M, page_tokens, E)
+    return pages.reshape(b, m * pool.shape[1], pool.shape[2])
+
+
+def paged_append(pool, table, new, start_pos, num_heads=1, active=None,
+                 valid=None):
+    """Scatter ``new`` (B, t, E) into the page pool at ring positions
+    [start_pos, start_pos + t) of each slot's page table.
+
+    ``start_pos`` — scalar or (B,) tokens already appended per slot.
+    ``active`` — optional (B,) 0/1 mask: rows with 0 (empty or mid-prefill
+    slots riding a fixed-shape batched step) redirect their writes to the
+    scratch page instead of touching real pages.  ``valid`` — optional (B,)
+    count of REAL rows within ``new``'s width (a padded final prefill
+    chunk): positions >= valid are redirected too, so pad garbage is never
+    written at all.  A :class:`QuantKV` pool quantizes on the way in, both
+    planes at the same slots.  The caller (serve.PagedKVManager) guarantees
+    every really-written page is exclusively owned — copy-on-write forks
+    shared pages BEFORE the step — so scatter indices never collide except
+    on the scratch page, whose contents are never read unmasked.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(pool, QuantKV):
+        qnew = quantize_kv(new, pool.data.dtype, num_heads)
+        return QuantKV(
+            paged_append(pool.data, table, qnew.data, start_pos,
+                         active=active, valid=valid),
+            paged_append(pool.scale, table, qnew.scale, start_pos,
+                         active=active, valid=valid))
+    b, t = new.shape[0], new.shape[1]
+    m = table.shape[1]
+    pt = pool.shape[1]
+    c = m * pt
+    start = jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32).reshape(-1),
+                             (b,))
+    new = new.astype(pool.dtype)
+    if t > c:
+        # only the latest C tokens can land (same trim as cache_append)
+        new = new[:, -c:]
+        start = start + (t - c)
+        t = c
+    pos = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # (B, t)
+    page = jnp.take_along_axis(table.astype(jnp.int32), (pos // pt) % m,
+                               axis=1)
+    write = jnp.ones((b, t), bool)
+    if active is not None:
+        write &= jnp.asarray(active).reshape(-1, 1).astype(bool)
+    if valid is not None:
+        write &= jnp.arange(t, dtype=jnp.int32)[None, :] \
+            < jnp.asarray(valid, jnp.int32).reshape(-1, 1)
+    page = jnp.where(write, page, 0)          # masked writes -> scratch
+    slot = pos % pt
+    return pool.at[page.reshape(-1), slot.reshape(-1)].set(
+        new.reshape(b * t, -1))
+
+
+def paged_copy(pool, src, dst):
+    """Copy page ``src`` -> page ``dst`` (traced scalar ids) in one pool —
+    the device half of a copy-on-write fork: the host allocator picks
+    ``dst``, this kernel duplicates the shared page, and the forking slot's
+    next append diverges in its own copy.  :class:`QuantKV` pools copy both
+    planes."""
+    if isinstance(pool, QuantKV):
+        return QuantKV(paged_copy(pool.data, src, dst),
+                       paged_copy(pool.scale, src, dst))
+    return pool.at[dst].set(pool[src])
+
+
 # Which path the last dot_product_attention dispatch traced: "flash" or
 # "einsum".  Written at trace time (dispatch happens under jit tracing), so
 # tests can assert the kernel path actually ran instead of silently

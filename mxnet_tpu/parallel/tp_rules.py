@@ -32,7 +32,8 @@ tests/test_tensor_parallel.py and tools/bandwidth.py.
 """
 from __future__ import annotations
 
-__all__ = ["plan_tensor_parallel", "kv_cache_pspec", "ELEMENTWISE_OPS"]
+__all__ = ["plan_tensor_parallel", "kv_cache_pspec", "kv_pool_pspec",
+           "ELEMENTWISE_OPS"]
 
 # ops through which a feature-sharded activation stays feature-sharded
 # (their compute is pointwise over the sharded dim, or reduces other dims)
@@ -61,6 +62,24 @@ def kv_cache_pspec(mesh_shape, batch_axis="data", head_axis="model"):
 
     sizes = dict(mesh_shape)
     return P(batch_axis if sizes.get(batch_axis, 1) > 1 else None, None,
+             head_axis if sizes.get(head_axis, 1) > 1 else None)
+
+
+def kv_pool_pspec(mesh_shape, head_axis="model"):
+    """PartitionSpec for a (P, page_tokens, E) paged KV pool on a mesh.
+
+    Same Megatron invariant as :func:`kv_cache_pspec` — the trailing E dim
+    shards on ``head_axis`` so each model shard holds and scores only its
+    own head group's slice of every page.  The page dim replicates: pages
+    are a GLOBAL id space shared by every serving slot (batch never enters
+    the pool's shape — slots meet the pool through their page tables), so
+    there is no batch axis to spread, and the page-id gathers/scatters
+    stay local per shard.  Axes of size 1 drop out.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    sizes = dict(mesh_shape)
+    return P(None, None,
              head_axis if sizes.get(head_axis, 1) > 1 else None)
 
 

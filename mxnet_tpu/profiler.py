@@ -18,7 +18,7 @@ import threading
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "Scope", "start", "stop", "record_host_wait", "record_input_wait",
            "record_step", "bump_metric_d2h", "bump_metric_sync",
-           "step_stats", "reset_step_stats"]
+           "record_request", "step_stats", "reset_step_stats"]
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "events": [], "jax_trace_dir": None}
@@ -37,6 +37,20 @@ _STEP_KEYS = ("steps", "host_wait_s", "input_wait_s", "metric_d2h",
 _step = dict.fromkeys(_STEP_KEYS, 0)
 _step["host_wait_s"] = _step["input_wait_s"] = 0.0
 _step["t0"] = time.time()
+
+# Per-request serving records (decode.DecodeServer retirements): each is a
+# dict with queue_wait_s (submit -> admission), ttft_s (submit -> first
+# token), tokens, decode_tokens_per_sec.  Bounded so a long-lived server
+# cannot grow the profiler without bound; step_stats() reports p50/p95 over
+# whatever is retained.
+_REQ_CAP = 4096
+_requests = []
+
+
+def _percentile(values, q):
+    """Nearest-rank percentile of a non-empty sorted list."""
+    idx = min(len(values) - 1, max(0, int(round(q * (len(values) - 1)))))
+    return values[idx]
 
 
 def _span(name, t0, dur):
@@ -79,12 +93,30 @@ def bump_metric_sync(n=1):
         _step["metric_syncs"] += n
 
 
+def record_request(queue_wait_s, ttft_s, tokens, decode_s):
+    """One served request retired (decode.DecodeServer): time queued
+    before admission, time to first token (from submit), tokens
+    delivered, and the wall time its post-first-token decode took."""
+    rec = {"queue_wait_s": float(queue_wait_s), "ttft_s": float(ttft_s),
+           "tokens": int(tokens),
+           "decode_tokens_per_sec":
+               (int(tokens) - 1) / max(float(decode_s), 1e-9)
+               if tokens > 1 else 0.0}
+    with _lock:
+        _requests.append(rec)
+        if len(_requests) > _REQ_CAP:
+            del _requests[:len(_requests) - _REQ_CAP]
+        _span("request", time.time() - max(float(ttft_s), 0.0),
+              max(float(ttft_s), 0.0))
+
+
 def reset_step_stats():
     with _lock:
         for k in _STEP_KEYS:
             _step[k] = 0
         _step["host_wait_s"] = _step["input_wait_s"] = 0.0
         _step["t0"] = time.time()
+        del _requests[:]
 
 
 def step_stats():
@@ -94,7 +126,21 @@ def step_stats():
     with _lock:
         out = {k: _step[k] for k in _STEP_KEYS}
         wall = max(time.time() - _step["t0"], 1e-9)
+        reqs = list(_requests)
     out["wall_s"] = wall
+    if reqs:
+        qw = sorted(r["queue_wait_s"] for r in reqs)
+        tf = sorted(r["ttft_s"] for r in reqs)
+        ts = sorted(r["decode_tokens_per_sec"] for r in reqs)
+        out["requests"] = {
+            "count": len(reqs),
+            "tokens": sum(r["tokens"] for r in reqs),
+            "queue_wait_p50_s": _percentile(qw, 0.50),
+            "queue_wait_p95_s": _percentile(qw, 0.95),
+            "ttft_p50_s": _percentile(tf, 0.50),
+            "ttft_p95_s": _percentile(tf, 0.95),
+            "decode_tokens_per_sec_p50": _percentile(ts, 0.50),
+        }
     out["input_stall_fraction"] = min(out["input_wait_s"] / wall, 1.0)
     out["host_wait_fraction"] = min(out["host_wait_s"] / wall, 1.0)
     steps = max(out["steps"], 1)

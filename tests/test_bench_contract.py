@@ -19,16 +19,20 @@ Tier-1 smoke run of the decode benchmark.
 `benchmarks/bench_decode.py --smoke` drives the KV-cached serving path
 (prefill program, donated decode-step program, recompute baseline,
 mixed-length continuous-batching serve in BOTH configurations — the PR-4
-dense-cache baseline and speculation x int8-quantized caches) at tiny
-dims and must emit the bench.py metric contract plus the decode
+dense-cache baseline and speculation x int8-quantized caches — plus the
+shared-system-prompt trace drained dense-ring AND paged+prefix-cache) at
+tiny dims and must emit the bench.py metric contract plus the decode
 accounting fields — the HLO-level dot-FLOP counts behind the
 O(1)-in-prefix assertion (which the bench itself enforces, nonzero exit
-on regression), the speculative accept-rate/steps accounting, and the
-static cache-byte + tokens/s/GB capacity headline.  The >= 2x
-serve-rate acceptance line is asserted by the bench itself at full dims;
-the smoke pins the noise-free halves (steps ratio, accept rate, cache
-bytes) and only reports the wall-clock ratio, because this harness's
-wall clock is shared-machine noise.
+on regression), the speculative accept-rate/steps accounting, the
+static cache-byte + tokens/s/GB capacity headline, and the paged-serving
+fields (serve_paged_tokens_per_sec_per_gb, prefix_cache_hit_rate,
+kv_hbm_utilization).  The >= 2x serve-rate and >= 2x tokens/s/GB
+acceptance lines are asserted by the bench itself at full dims; the
+bench asserts the noise-free paged halves at every dims (token identity
+vs the dense-ring drain, zero retraces, hit rate > 0) and the smoke pins
+them again from the JSON, only REPORTING wall-clock ratios, because this
+harness's wall clock is shared-machine noise.
 """
 import json
 import os
@@ -162,28 +166,45 @@ def test_bench_decode_smoke_contract():
     # deterministic halves above already pin the win
     assert head["vs_pr4_serve"] > 0, head
 
+    # --- the paged + prefix-cache serving contract ---
+    # deterministic halves only (the bench itself asserts token identity
+    # with the dense-ring drain and zero retraces, exiting nonzero):
+    # the prefix cache must have removed real prefill work, the pool must
+    # be neither unused nor silently over-provisioned, and the paged pool
+    # must undercut the dense rings' bytes on the same trace
+    assert head["prefix_cache_hit_rate"] > 0, head
+    assert 0 < head["kv_hbm_utilization"] <= 1, head
+    assert head["serve_paged_tokens_per_sec"] > 0, head
+    assert head["serve_paged_tokens_per_sec_per_gb"] > 0, head
+    assert head["vs_pr6_per_gb"] > 0, head
+
     # stderr: one JSON per phase, all phases present
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     phases = {r.get("phase") for r in rows}
     assert {"flops", "prefill", "decode", "naive", "serve",
-            "serve_spec_quant"} <= phases, phases
+            "serve_spec_quant", "serve_paged"} <= phases, phases
     spec_row = next(r for r in rows if r.get("phase") == "serve_spec_quant")
     dense_row = next(r for r in rows if r.get("phase") == "serve")
     assert spec_row["spec_steps"] > 0
     assert spec_row["decode_steps"] * 2 <= dense_row["decode_steps"]
+    paged_row = next(r for r in rows if r.get("phase") == "serve_paged")
+    assert paged_row["pool_bytes"] < paged_row["dense_ring_bytes"]
+    assert paged_row["spec_steps"] > 0
 
 
 def test_mxlint_smoke_contract():
-    """`tools/mxlint.py --smoke` must audit all eight canonical programs
+    """`tools/mxlint.py --smoke` must audit all ten canonical programs
     (the speculative trio — draft_step / verify_step / decode_step_q —
-    driven by a real mixed-length speculative serve) with all six passes
-    and report ZERO unsuppressed findings — the static-analysis
-    acceptance line: donation aliasing, collective budgets, retrace
-    counts (exactly one trace each for draft, verify and decode
-    programs), host-sync lint, FLOP/dtype coverage and cache-byte
-    budgets all green against benchmarks/budgets.json on the
-    8-virtual-device CPU platform."""
+    driven by a real mixed-length speculative serve; the paged pair —
+    paged_decode_step / paged_verify_step — by a real shared-prefix
+    paged serve with chunked prefill, COW forks and retirements) with
+    all six passes and report ZERO unsuppressed findings — the
+    static-analysis acceptance line: donation aliasing, collective
+    budgets, retrace counts, host-sync lint, FLOP/dtype coverage and
+    cache-byte budgets (pool bytes for the paged programs) all green
+    against benchmarks/budgets.json on the 8-virtual-device CPU
+    platform."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     # scrub analysis knobs: the smoke must measure the committed budget
@@ -205,14 +226,14 @@ def test_mxlint_smoke_contract():
     assert head["value"] == 0 and head["vs_baseline"] == 1.0, head
     assert head["errors"] == 0 and head["warnings"] == 0, head
     # every canonical program was built (the virtual mesh gives ring×TP)
-    assert head["programs"] == 8 and head["passes"] == 6, head
+    assert head["programs"] == 10 and head["passes"] == 6, head
     assert head["skipped_programs"] == [], head
 
     # stderr: one JSON finding per line; every (pass, program) pair ran
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     pairs = {(r["pass"], r["program"]) for r in rows if "pass" in r}
-    assert len(pairs) == 48, sorted(pairs)
+    assert len(pairs) == 60, sorted(pairs)
     assert all(r["severity"] == "info" for r in rows if "pass" in r), rows
     # the quantized decode/verify programs really carry narrow caches
     # within their committed ceilings (not the f32 fallback)
@@ -220,8 +241,12 @@ def test_mxlint_smoke_contract():
                   if r.get("pass") == "cache-bytes"
                   and r["code"] == "within-budget"}
     for prog in ("decode_step", "decode_step_q", "draft_step",
-                 "verify_step"):
+                 "verify_step", "paged_decode_step", "paged_verify_step"):
         assert prog in cache_rows, sorted(cache_rows)
     assert cache_rows["decode_step_q"]["detail"]["kv_dtype"] == "int8"
     assert cache_rows["decode_step_q"]["detail"]["measured"] * 2 <= \
         cache_rows["decode_step"]["detail"]["measured"] * 1.2
+    # the paged programs audit POOL bytes (the paged layout recorded)
+    for prog in ("paged_decode_step", "paged_verify_step"):
+        assert cache_rows[prog]["detail"]["layout"] == "paged", \
+            cache_rows[prog]

@@ -2,11 +2,12 @@
 """mxlint — static program-analysis lint over the framework's canonical
 compiled programs.
 
-Builds the eight canonical programs on the current backend (``--smoke``
+Builds the ten canonical programs on the current backend (``--smoke``
 forces the 8-virtual-device CPU platform so the ring×TP mesh program
 exists on one box; the speculative trio — draft_step / verify_step /
-decode_step_q — is driven by a real mixed-length speculative serve),
-snapshots each as a
+decode_step_q — is driven by a real mixed-length speculative serve, and
+the paged pair — paged_decode_step / paged_verify_step — by a real
+shared-prefix paged serve), snapshots each as a
 :class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` (jaxpr + lowered
 StableHLO + compiled HLO + donation/retrace/dtype/cache metadata), and
 runs the six analysis passes against the committed budget file:
@@ -78,7 +79,7 @@ def _parse_args(argv):
         "compiled programs (see docs/static_analysis.md)")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 CI mode: force the 8-virtual-device CPU "
-                    "platform and audit all eight programs")
+                    "platform and audit all ten programs")
     ap.add_argument("--programs", default="",
                     help="comma-filter of canonical programs (default all)")
     ap.add_argument("--budgets", default="",
