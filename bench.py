@@ -235,13 +235,20 @@ def main():
 def smoke():
     """Tier-1 smoke: a small MLP fit on the CPU harness through the full
     async loop (device metrics, device prefetch, bounded in-flight
-    dispatch), reporting the loop-accounting contract fields."""
+    dispatch) UNDER async fenced checkpointing, reporting the
+    loop-accounting contract fields — including the elastic trio
+    (checkpoint_stall_fraction / last_ckpt_ms / recoveries, whose
+    deterministic halves tests/test_bench_contract.py pins: writes
+    happened, no recovery on a clean run)."""
+    import shutil
+    import tempfile
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
-    from mxnet_tpu import profiler
+    from mxnet_tpu import elastic, profiler
 
     batch, steps_per_epoch, epochs = 32, 25, 2
     rng = np.random.RandomState(0)
@@ -256,25 +263,41 @@ def smoke():
     net = mx.sym.SoftmaxOutput(net, name="softmax")
     mod = mx.mod.Module(net, context=mx.cpu())
 
+    ckpt_dir = tempfile.mkdtemp(prefix="mxtpu_bench_ckpt_")
+    ctl = elastic.ElasticController(checkpointer=elastic.Checkpointer(
+        ckpt_dir, period=max(steps_per_epoch // 2, 1), async_write=True))
     profiler.reset_step_stats()
     tic = time.time()
-    mod.fit(it, eval_metric="acc", num_epoch=epochs, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-            initializer=mx.initializer.Xavier())
-    toc = time.time()
-    stats = profiler.step_stats()
+    try:
+        mod.fit(it, eval_metric="acc", num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.initializer.Xavier(), elastic=ctl)
+        toc = time.time()
+        stats = profiler.step_stats()
+        ckpt_writes = ctl.checkpointer.writes
+        steps_during_write = ctl.checkpointer.steps_during_write
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
     if mod._fused_step is None:
         print("WARNING: fused train step not active", file=sys.stderr)
     print(json.dumps({"loop_stats": {k: stats[k] for k in
                                      ("steps", "host_wait_s", "input_wait_s",
-                                      "metric_d2h", "metric_syncs")}}),
+                                      "metric_d2h", "metric_syncs",
+                                      "ckpt_stall_s", "ckpt_writes",
+                                      "recoveries")}}),
           file=sys.stderr)
     n = max(stats["steps"], 1)
     print(contract_line(
         "async_fit_mlp_imgs_per_sec_bs%d" % batch,
         round(batch * n / (toc - tic), 2), "img/s", 1.0,
         input_stall_fraction=round(stats["input_stall_fraction"], 4),
-        host_syncs_per_step=round(stats["host_syncs_per_step"], 4)))
+        host_syncs_per_step=round(stats["host_syncs_per_step"], 4),
+        checkpoint_stall_fraction=round(stats["checkpoint_stall_fraction"],
+                                        4),
+        last_ckpt_ms=round(stats["last_ckpt_ms"], 2),
+        ckpt_writes=ckpt_writes,
+        ckpt_steps_during_write=steps_during_write,
+        recoveries=stats["recoveries"]))
 
 
 if __name__ == "__main__":

@@ -1,6 +1,6 @@
 """Builders for the canonical programs the lint audits.
 
-``tools/mxlint.py`` (and the tier-1 smoke) checks ten programs — the
+``tools/mxlint.py`` (and the tier-1 smoke) checks eleven programs — the
 compiled surfaces behind every headline number so far:
 
 * ``train_step``  — the fused forward+backward+optimizer program
@@ -25,7 +25,15 @@ compiled surfaces behind every headline number so far:
 * ``ring_tp_step`` — the attention-LM fused step on the composed
   (data, seq, model) mesh: ring attention with head groups sharded on
   'model' (needs >= 4 devices; the smoke forces the 8-virtual-device
-  CPU platform, same trick as tests/conftest.py).
+  CPU platform, same trick as tests/conftest.py);
+* ``ckpt_train_step`` — the fused step of a ``fit()`` run UNDER async
+  fenced checkpointing (``mxnet_tpu.elastic``): fences snapshot the
+  donated chain and a writer thread lands committed orbax steps while
+  the loop keeps dispatching, and the host-sync pass then proves the
+  checkpoint machinery added no callback primitives or host-transfer
+  ops to the compiled program — the fence d2h lives on the writer
+  thread, OUTSIDE the program (the sanctioned-transfer story in
+  docs/static_analysis.md).
 
 Every program is driven at least twice at identical shapes before its
 artifact is snapshotted, so the retrace pass checks a real "second call
@@ -52,7 +60,7 @@ __all__ = ["CANONICAL_PROGRAMS", "build_canonical_artifacts"]
 CANONICAL_PROGRAMS = ("train_step", "eval_step", "prefill", "decode_step",
                       "decode_step_q", "draft_step", "verify_step",
                       "paged_decode_step", "paged_verify_step",
-                      "ring_tp_step")
+                      "ring_tp_step", "ckpt_train_step")
 
 # tiny-but-structured dims shared by every builder
 _MLP = dict(batch=8, features=32, hidden=32, classes=8)
@@ -293,6 +301,58 @@ def _paged_artifacts():
                                  name="paged_verify_step"))
 
 
+def _ckpt_train_step_artifact():
+    """The fused step of a real ``fit()`` under async fenced
+    checkpointing.
+
+    A small MLP fit runs with an :class:`~mxnet_tpu.elastic.Checkpointer`
+    armed (period 3, async writer): fence snapshots dispatch device
+    copies and a background thread commits orbax step directories while
+    the loop keeps stepping.  The artifact snapshots AFTER at least one
+    commit, so the host-sync pass audits a program that demonstrably
+    coexisted with live checkpointing — any callback primitive or
+    host-transfer op the checkpoint path leaked into the step would land
+    here as an error."""
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic
+    from mxnet_tpu.io import NDArrayIter
+
+    d = _MLP
+    rng = np.random.RandomState(4)
+    X = rng.uniform(-1, 1, (d["batch"] * 6, d["features"])) \
+        .astype(np.float32)
+    y = rng.randint(0, d["classes"], (d["batch"] * 6,)).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=d["batch"])
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=d["hidden"], name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=d["classes"], name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype="bfloat16")
+
+    tmp = tempfile.mkdtemp(prefix="mxlint_ckpt_")
+    try:
+        ctl = elastic.ElasticController(checkpointer=elastic.Checkpointer(
+            tmp, period=3, async_write=True))
+        mod.fit(it, num_epoch=2, eval_metric="acc", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.initializer.Xavier(), elastic=ctl)
+        if ctl.checkpointer.writes < 1:
+            raise MXNetError("fit-under-checkpoint drive committed no "
+                             "fence checkpoint; the ckpt_train_step "
+                             "artifact would not cover live checkpointing")
+        if mod._fused_step is None:
+            raise MXNetError("fused train step did not arm under "
+                             "checkpointing")
+        return mod._fused_step.artifact(name="ckpt_train_step")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _ring_mesh_config(n_dev):
     from mxnet_tpu.parallel import MeshConfig
 
@@ -304,7 +364,7 @@ def _ring_mesh_config(n_dev):
 
 
 def build_canonical_artifacts(names=None):
-    """Build the requested canonical artifacts (default: all ten).
+    """Build the requested canonical artifacts (default: all eleven).
 
     Returns ``(artifacts, notes)`` — ``notes`` maps a program that could
     not be built on this host (e.g. ``ring_tp_step`` without >= 4
@@ -352,6 +412,9 @@ def build_canonical_artifacts(names=None):
             artifacts.append(paged_decode)
         if "paged_verify_step" in want:
             artifacts.append(paged_verify)
+
+    if "ckpt_train_step" in want:
+        artifacts.append(_ckpt_train_step_artifact())
 
     if "ring_tp_step" in want:
         cfg = _ring_mesh_config(len(jax.devices()))

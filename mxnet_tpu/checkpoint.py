@@ -13,6 +13,18 @@ params/aux/optimizer state, and restore re-shards onto the live mesh.
 
 Works on any module bound over a mesh (or a single device — then it is
 simply an async, atomic checkpoint directory).
+
+Commit protocol: a step directory counts as a checkpoint only once it is
+*committed* — orbax's atomic rename has landed AND the commit marker
+(:data:`COMMIT_MARKER`, written last) is present.  ``latest_step`` skips
+uncommitted/torn directories — including post-rename crash debris that
+carries orbax's own metadata but never reached the marker — so a crash
+mid-save can never poison resume by becoming the "latest" checkpoint.
+Adopt a checkpoint written by external orbax tooling with
+:func:`commit_step`.  The elastic subsystem
+(``mxnet_tpu.elastic``) builds its fence checkpoints on these exact
+primitives and adds a sidecar with loop state (RNG chain, metric sums,
+iterator cursor) for deterministic resume.
 """
 from __future__ import annotations
 
@@ -20,7 +32,12 @@ import os
 
 from .base import MXNetError
 
-__all__ = ["save_sharded", "load_sharded", "latest_step"]
+__all__ = ["save_sharded", "load_sharded", "latest_step", "save_state_tree",
+           "commit_step", "is_committed", "COMMIT_MARKER"]
+
+# written LAST, inside the finalized step directory; mirrors the name orbax
+# itself uses on non-atomic filesystems (GCS) so external tooling recognizes it
+COMMIT_MARKER = "commit_success.txt"
 
 
 def _state_of(module):
@@ -42,24 +59,75 @@ def _state_of(module):
     return state
 
 
+def save_state_tree(directory, step, state):
+    """Write an arbitrary pytree of jax arrays as the step's orbax
+    checkpoint and commit it (marker written after the atomic rename).
+    The building block ``save_sharded`` and the elastic fence writer
+    share; safe to call from a background writer thread."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(directory), str(step))
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        ckptr.save(path, state, force=True)
+    return path
+
+
+def commit_step(path):
+    """Drop the commit marker into a finalized step directory — the LAST
+    write of a checkpoint.  ``latest_step`` only ever returns committed
+    steps, so a crash anywhere before this leaves the previous checkpoint
+    as the resume point instead of a torn directory."""
+    with open(os.path.join(path, COMMIT_MARKER), "w") as f:
+        f.write("committed\n")
+    return path
+
+
+def is_committed(directory, step):
+    """Whether ``directory/step`` is a complete, committed checkpoint —
+    the marker file is the ONLY accepted evidence.  Orbax writes its own
+    ``_CHECKPOINT_METADATA`` inside the renamed directory, so accepting
+    it would count the debris of a crash *between* the rename and the
+    sidecar/marker writes as committed; checkpoints produced by external
+    orbax tooling must be adopted explicitly with :func:`commit_step`."""
+    path = os.path.join(os.path.abspath(directory), str(step))
+    return os.path.isdir(path) and \
+        os.path.exists(os.path.join(path, COMMIT_MARKER))
+
+
 def save_sharded(directory, step, module):
     """Write an orbax checkpoint of the module's params/aux (+fused
     optimizer slots) at ``directory/step`` — every host writes its own
-    shards; the directory commit is atomic."""
-    import orbax.checkpoint as ocp
-
+    shards; the directory commit is atomic and marker-finalized."""
     assert module.binded and module.params_initialized
-    path = os.path.join(os.path.abspath(directory), str(step))
-    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        ckptr.save(path, _state_of(module), force=True)
-    return path
+    path = save_state_tree(directory, step, _state_of(module))
+    return commit_step(path)
+
+
+def _disk_tree(ckptr, path):
+    """The saved checkpoint's structure-with-array-metadata, across orbax
+    API generations: modern releases return the tree dict directly from
+    ``metadata()``; older ones wrap it as ``.item_metadata.tree``."""
+    md = ckptr.metadata(path)
+    if isinstance(md, dict):
+        return md
+    item = getattr(md, "item_metadata", None)
+    tree = getattr(item, "tree", None)
+    if tree is not None:
+        return tree
+    if isinstance(item, dict):
+        return item
+    raise MXNetError("unrecognized orbax metadata layout for %s: %r"
+                     % (path, type(md).__name__))
 
 
 def load_sharded(directory, step, module):
     """Restore params/aux (+slots when both sides have them) in place,
     re-sharded to the module's live mesh placement.  Structure differences
     are tolerated: a training checkpoint (with optimizer slots) restores
-    into an inference module, and vice versa."""
+    into an inference module, and vice versa — a slot-less checkpoint
+    loaded into a training module synthesizes FRESH optimizer slots (zero
+    moments) rather than keeping moments from whatever the module trained
+    on before."""
     import jax
     import logging
 
@@ -83,7 +151,7 @@ def load_sharded(directory, step, module):
         # synthesize plain abstract leaves for on-disk sections the module
         # does not carry (e.g. slots into an inference module), and drop
         # module sections absent on disk (restored state leaves them as-is)
-        disk_tree = ckptr.metadata(path).item_metadata.tree
+        disk_tree = _disk_tree(ckptr, path)
         target = {}
         for key, sub in disk_tree.items():
             if key in abstract:
@@ -113,13 +181,23 @@ def load_sharded(directory, step, module):
             # restored slots are now the live optimizer state — a later
             # fused step must not re-import stale eager updater moments
             module._opt_owner = "fused"
+        elif fused.slots:
+            # slot-less (inference) checkpoint into a training module: the
+            # restored params deserve FRESH moments, not the moments of the
+            # weights they just replaced; owning them as "fused" keeps a
+            # stale eager updater from re-importing the old ones either
+            fused.reset_slots()
+            module._opt_owner = "fused"
     module._params_dirty = True
     return module
 
 
 def latest_step(directory):
-    """Highest step number checkpointed under ``directory`` (or None)."""
+    """Highest COMMITTED step number checkpointed under ``directory`` (or
+    None).  Torn directories — a crash mid-save, an in-flight async write,
+    an orbax tmp dir — are skipped, never returned as the resume point."""
     if not os.path.isdir(directory):
         return None
-    steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
+    steps = [int(d) for d in os.listdir(directory)
+             if d.isdigit() and is_committed(directory, d)]
     return max(steps) if steps else None

@@ -259,6 +259,54 @@ register("MXNET_ANALYSIS_BUDGETS", str, "",
          "Path to the static-analysis budget file consumed by "
          "analysis.load_budgets / tools/mxlint.py.  Empty (default) = "
          "the committed benchmarks/budgets.json.")
+register("MXNET_CKPT_DIR", str, "",
+         "Directory for elastic fence checkpoints (mxnet_tpu.elastic).  "
+         "Set together with MXNET_CKPT_PERIOD to arm fit()-integrated "
+         "async fenced checkpointing: at every period-th step fence the "
+         "donated params/slots/aux chain is snapshotted on device (cheap "
+         "async copies) and written as a committed orbax step directory "
+         "by a background writer thread, with a sidecar carrying the loop "
+         "state (epoch/step, RNG chain, metric sums, iterator cursor) for "
+         "deterministic resume.  Empty (default) = no automatic "
+         "checkpointing; an explicit elastic.ElasticController passed to "
+         "fit() overrides the environment.")
+register("MXNET_CKPT_PERIOD", int, 0,
+         "Steps between elastic fence checkpoints (0 = off).  Snapshots "
+         "ride the in-flight step machinery: the copy dispatch depends on "
+         "the latest dispatched step, so the loop never blocks on the "
+         "device to checkpoint.")
+register("MXNET_CKPT_ASYNC", bool, True,
+         "Write fence checkpoints on a background writer thread (at most "
+         "ONE write in flight; a fence landing while a write is busy is "
+         "skipped, not queued — the next fence writes).  0 = synchronous "
+         "saves on the loop thread, the A/B baseline whose stall the "
+         "checkpoint_stall_fraction bench field quantifies (its d2h is "
+         "the sanctioned fence transfer, exempt from "
+         "MXNET_TRANSFER_GUARD).")
+register("MXNET_CKPT_KEEP", int, 2,
+         "Committed fence checkpoints to retain (older step directories "
+         "are pruned after each commit; 0 = keep all).  Two is the "
+         "crash-safe minimum floor: the newest commit plus its "
+         "predecessor, in case a torn successor must be discarded.")
+register("MXNET_CKPT_RESUME", bool, True,
+         "Auto-resume: when the checkpoint directory already holds a "
+         "committed step at fit() start, restore it (params, optimizer "
+         "slots, RNG chain, metric sums, iterator cursor) and continue "
+         "from the recorded epoch/step instead of training from scratch.  "
+         "0 = always start fresh (the directory still receives new "
+         "checkpoints).")
+register("MXNET_ELASTIC_POLL", int, 1,
+         "Poll the failure monitor every N step fences (elastic liveness "
+         "protocol).  Each poll is num_workers stat/read calls on the "
+         "heartbeat directory — no device work.")
+register("MXNET_ELASTIC_TIMEOUT", float, 10.0,
+         "Heartbeat staleness threshold (seconds) for the elastic "
+         "FailureMonitor: a rank whose stamp is older is declared dead "
+         "and the mesh shrinks off its data rows at the next fence.")
+register("MXNET_ELASTIC_GRACE", float, 30.0,
+         "Startup allowance (seconds) for registered-but-not-yet-stamped "
+         "workers: within this window of the heartbeat directory's epoch "
+         "a missing first stamp does not read as dead.")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

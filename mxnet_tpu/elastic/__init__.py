@@ -1,0 +1,41 @@
+"""Elastic training: async fenced checkpointing, liveness-driven mesh
+shrink/regrow, and deterministic resume.
+
+The reference's recovery contract is "ps-lite heartbeats detect the dead
+worker, a human resumes from the last epoch checkpoint"
+(``parallel/health.py``; SURVEY §5).  At production scale preemptions are
+routine, so this subsystem makes recovery automatic, cheap and exact:
+
+* **Async fenced checkpointing** (:class:`Checkpointer`): at a step fence
+  the donated params/slots/aux chain is snapshotted with device-side
+  copies — async dispatches that ride the in-flight step machinery, so
+  ``fit()`` keeps dispatching — and a background writer thread lands the
+  shards as a committed orbax step directory (at most one write in
+  flight; crash-safe commit ordering via ``checkpoint.commit_step``).
+* **Deterministic resume**: the checkpoint carries epoch/step, the RNG
+  key chain, metric accumulator sums and the iterator cursor, so a
+  killed-and-restarted ``fit()`` replays to bit-identical params vs an
+  uninterrupted run (Check-Freq's decoupled-snapshot plan, taken to
+  exact-replay).
+* **Liveness protocol** (:class:`~mxnet_tpu.parallel.health.FailureMonitor`
+  + :class:`ElasticController`): a heartbeat-declared dead rank raises a
+  reconfiguration at the next fence; the loop drains in-flight steps,
+  re-forms the mesh on the survivors' devices (the 'data' axis shrinks,
+  per-replica batch rescales, global batch unchanged), restores the last
+  fence checkpoint re-sharded onto the new mesh, and continues — regrow
+  runs the same path when the worker returns.
+
+Wiring: pass an :class:`ElasticController` to ``fit(..., elastic=...)``,
+or set ``MXNET_CKPT_DIR`` + ``MXNET_CKPT_PERIOD`` and ``fit`` arms one
+itself (:func:`from_env`).  :class:`FaultInjector` drives all of it
+deterministically in tests (kill at step N, stale heartbeat, torn
+write).  See docs/elasticity.md.
+"""
+from ..parallel.health import FailureMonitor, ReconfigEvent
+from .checkpointer import Checkpointer
+from .controller import ElasticController, ReconfigureSignal, from_env
+from .fault import FaultInjector, WorkerKilled
+
+__all__ = ["Checkpointer", "ElasticController", "ReconfigureSignal",
+           "FailureMonitor", "ReconfigEvent", "FaultInjector",
+           "WorkerKilled", "from_env"]

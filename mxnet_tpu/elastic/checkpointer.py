@@ -1,0 +1,314 @@
+"""Async fenced checkpointing — snapshot on device, write off the hot loop.
+
+Check-Freq's observation (Mohan et al., FAST '21): checkpoint stalls
+vanish when the *snapshot* (cheap, must be consistent) is decoupled from
+the *write* (slow, needs no loop participation).  Here the snapshot is a
+set of ``jnp.copy`` dispatches against the fused step's donated
+params/slots/aux chain — they sequence after the latest dispatched step
+and before the next one, so the state they capture is exactly
+"after step N" without any host sync — and the write is an orbax save on
+a background thread that materializes those copies (the d2h) and lands a
+committed step directory (``checkpoint.save_state_tree`` + sidecar +
+``commit_step``, in that order, so a crash anywhere leaves the previous
+checkpoint as the resume point).
+
+At most one write is in flight: a fence arriving while the writer is busy
+is *skipped* (counted in ``skipped_busy``), never queued — checkpoints
+are periodic, the next fence writes.  ``MXNET_CKPT_ASYNC=0`` runs the
+writer inline on the loop thread (the A/B baseline for the
+``checkpoint_stall_fraction`` bench field); its d2h is the sanctioned
+fence transfer, wrapped in an explicit ``transfer_guard`` allow scope so
+``MXNET_TRANSFER_GUARD=disallow`` stays armable around the rest of the
+loop.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+
+from .. import checkpoint as ckpt_mod
+from ..base import MXNetError
+
+__all__ = ["Checkpointer", "SIDECAR"]
+
+SIDECAR = "elastic.json"
+
+log = logging.getLogger(__name__)
+
+
+def _metric_device_copy(module):
+    """Device-side copies of the fused step's metric accumulator state
+    (per metric leaf: ``[[sums...], [counts...]]``), or None.  Copies are
+    async dispatches; the writer thread materializes them."""
+    import jax.numpy as jnp
+
+    fused = getattr(module, "_fused_step", None)
+    acc = getattr(fused, "_metric_acc", None) if fused is not None else None
+    if acc is None or acc.state is None:
+        return None
+    return [[[jnp.copy(s) for s in sums], [jnp.copy(c) for c in counts]]
+            for sums, counts in acc.state]
+
+
+class Checkpointer:
+    """Periodic fenced checkpoints of a training module into one
+    directory of committed orbax step dirs (step = global step number),
+    each with an ``elastic.json`` sidecar carrying the loop state for
+    deterministic resume."""
+
+    def __init__(self, directory, period=None, async_write=None, keep=None,
+                 resume=None):
+        from .. import config as _config
+
+        self.directory = os.path.abspath(directory)
+        self.period = int(_config.get("MXNET_CKPT_PERIOD")
+                          if period is None else period)
+        self.async_write = bool(_config.get("MXNET_CKPT_ASYNC")
+                                if async_write is None else async_write)
+        self.keep = int(_config.get("MXNET_CKPT_KEEP")
+                        if keep is None else keep)
+        self.resume = bool(_config.get("MXNET_CKPT_RESUME")
+                           if resume is None else resume)
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = None
+        self._error = None
+        self.writes = 0          # committed checkpoints
+        self.skipped_busy = 0    # fences skipped because a write was in flight
+        self.steps_during_write = 0  # steps dispatched while a write ran
+
+    # ------------------------------------------------------------------
+    def writing(self):
+        """Whether a background write is currently in flight."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def note_step(self):
+        """Called once per training step by the controller: counts steps
+        that overlapped an in-flight write (the overlap the async design
+        exists to produce — asserted by the bench/tests)."""
+        if self.writing():
+            self.steps_during_write += 1
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise MXNetError("elastic checkpoint write failed: %s" % (err,))
+
+    # ------------------------------------------------------------------
+    def snapshot(self, module, meta):
+        """Take a fence checkpoint of ``module`` (loop thread).
+
+        ``meta`` is the controller-assembled sidecar dict (epoch,
+        nbatch_done, global_step, metric host sums, iterator record).
+        Returns True when a write was started/performed, False when
+        skipped because one is already in flight.  Only the device-copy
+        dispatches and (async) thread start run here — the loop never
+        blocks on d2h or disk."""
+        from .. import profiler as _prof
+        from .. import random as _rnd
+
+        t0 = time.perf_counter()
+        try:
+            self._raise_pending()
+            if self.writing():
+                self.skipped_busy += 1
+                return False
+            job = {
+                "state": self._device_snapshot(module),
+                "meta": dict(meta),
+                # the key chain is thread-local: capture the ARRAY here on
+                # the loop thread; the writer only materializes it
+                "rng": _rnd._key(),
+                "metric_device": _metric_device_copy(module),
+            }
+            if self.async_write:
+                self._thread = threading.Thread(
+                    target=self._write_guarded, args=(job,), daemon=True,
+                    name="mxtpu-ckpt-writer")
+                self._thread.start()
+            else:
+                self._write_allowed(job)
+            return True
+        finally:
+            _prof.record_ckpt_stall(time.perf_counter() - t0)
+
+    def _device_snapshot(self, module):
+        """Consistent device-side copies of params/aux (+fused optimizer
+        slots).  With the fused step owning state these copy the master
+        store — the arrays the NEXT step will donate, so the copies must
+        (and do) dispatch before it.  On the eager path the executor
+        buffers are copied; optimizer slots then live in the eager
+        updater and are not fenced (resume re-seeds fresh moments — the
+        fused path is the deterministic-resume path)."""
+        import jax.numpy as jnp
+
+        fused = getattr(module, "_fused_step", None)
+        if fused is not None and module._opt_owner == "fused" \
+                and not module._step_stale:
+            state = {"params": {n: jnp.copy(v)
+                                for n, v in fused.params.items()},
+                     "aux": {n: jnp.copy(v) for n, v in fused.aux.items()}}
+            if fused.slots:
+                state["slots"] = {n: [jnp.copy(s) for s in v]
+                                  for n, v in fused.slots.items()}
+            return state
+        exe = module._exec_group.exec_
+        return {"params": {n: jnp.copy(exe.arg_dict[n].data)
+                           for n in module._exec_group.param_names},
+                "aux": {n: jnp.copy(exe.aux_dict[n].data)
+                        for n in module._exec_group.aux_names}}
+
+    # ------------------------------------------------------------------
+    def _write_guarded(self, job):
+        try:
+            self._write(job)
+        except Exception as exc:  # surfaced on the loop thread next fence
+            log.warning("elastic checkpoint write failed: %s", exc)
+            self._error = exc
+
+    def _write_allowed(self, job):
+        """Inline (synchronous) write on the loop thread: its d2h is the
+        sanctioned fence transfer — explicitly allow-listed so an armed
+        MXNET_TRANSFER_GUARD=disallow loop can still checkpoint."""
+        import jax
+
+        with jax.transfer_guard_device_to_host("allow"):
+            self._write(job)
+
+    def _write(self, job):
+        import numpy as np
+
+        from .. import profiler as _prof
+
+        t0 = time.perf_counter()
+        step = int(job["meta"]["global_step"])
+        # 1. shards land under an orbax tmp dir, atomically renamed to
+        #    directory/<step> when complete (this materializes the copies)
+        path = ckpt_mod.save_state_tree(self.directory, step, job["state"])
+        # 2. sidecar: loop state for deterministic resume
+        sidecar = dict(job["meta"])
+        rng = np.asarray(job["rng"])
+        sidecar["rng_key"] = rng.tolist()
+        sidecar["rng_dtype"] = str(rng.dtype)
+        dev = job["metric_device"]
+        if dev is not None:
+            sidecar["metric_device"] = [
+                [[float(np.asarray(s)) for s in sums],
+                 [float(np.asarray(c)) for c in counts]]
+                for sums, counts in dev]
+        tmp = os.path.join(path, SIDECAR + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(sidecar, f)
+        os.replace(tmp, os.path.join(path, SIDECAR))
+        # 3. the commit marker is the LAST write: latest_step only ever
+        #    resumes from steps that got this far
+        ckpt_mod.commit_step(path)
+        self.writes += 1
+        _prof.record_ckpt_write((time.perf_counter() - t0) * 1e3)
+        self._prune()
+
+    def _prune(self):
+        entries = os.listdir(self.directory)
+        committed = sorted(s for s in (int(d) for d in entries
+                                       if d.isdigit())
+                           if ckpt_mod.is_committed(self.directory, s))
+        if not committed:
+            return
+        newest = committed[-1]
+        if self.keep > 0:
+            for s in committed[:-self.keep]:
+                shutil.rmtree(os.path.join(self.directory, str(s)),
+                              ignore_errors=True)
+        # torn debris below the newest commit is provably dead (the one
+        # in-flight write is always the newest step): crash leftovers —
+        # uncommitted step dirs and orbax tmp dirs — must not accumulate
+        # shard payloads forever in a long-lived checkpoint directory
+        for name in entries:
+            if name.isdigit():
+                s = int(name)
+                dead = s < newest and not ckpt_mod.is_committed(
+                    self.directory, s)
+            else:
+                head = name.split(".", 1)[0]
+                dead = ".orbax-checkpoint-tmp" in name and \
+                    head.isdigit() and int(head) < newest
+            if dead:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    def wait(self):
+        """Join any in-flight write (epoch/fit end, pre-restore barrier)."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._raise_pending()
+
+    def latest(self):
+        """Highest committed step that also carries the elastic sidecar."""
+        if not os.path.isdir(self.directory):
+            return None
+        steps = [int(d) for d in os.listdir(self.directory)
+                 if d.isdigit() and ckpt_mod.is_committed(self.directory, d)
+                 and os.path.exists(os.path.join(self.directory, d, SIDECAR))]
+        return max(steps) if steps else None
+
+    def peek(self):
+        """The latest committed fence's sidecar meta WITHOUT touching the
+        module (attach() sanity-checks epoch compatibility before the
+        destructive restore), or None."""
+        self.wait()
+        step = self.latest()
+        if step is None:
+            return None
+        with open(os.path.join(self.directory, str(step), SIDECAR)) as f:
+            return json.load(f)
+
+    def restore(self, module):
+        """Restore the latest committed fence checkpoint into ``module``
+        (params/aux/slots re-sharded to its live mesh, RNG chain reset to
+        the fence value) and return the sidecar meta dict — or None when
+        the directory holds no committed elastic checkpoint."""
+        import numpy as np
+
+        self.wait()
+        step = self.latest()
+        if step is None:
+            return None
+        ckpt_mod.load_sharded(self.directory, step, module)
+        with open(os.path.join(self.directory, str(step), SIDECAR)) as f:
+            meta = json.load(f)
+        self._restore_rng(meta)
+        self._restore_optimizer(module, meta)
+        return meta
+
+    @staticmethod
+    def _restore_optimizer(module, meta):
+        """Update counts back to the fence values: Adam's bias correction
+        and lr schedules read them, so replayed step t must really be
+        step t (the slots themselves rode the orbax tree)."""
+        opt = getattr(module, "_optimizer", None)
+        rec = meta.get("optimizer")
+        if opt is None or not rec:
+            return
+        opt.begin_num_update = int(rec["begin_num_update"])
+        opt.num_update = int(rec["num_update"])
+        opt._index_update_count = {
+            int(k): int(v)
+            for k, v in rec.get("index_update_count", {}).items()}
+
+    @staticmethod
+    def _restore_rng(meta):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .. import random as _rnd
+
+        key = meta.get("rng_key")
+        if key is None:
+            return
+        _rnd._state.key = jnp.asarray(
+            np.asarray(key, dtype=meta.get("rng_dtype", "uint32")))

@@ -1,0 +1,316 @@
+"""The elastic controller — fit()'s fault-tolerance sidecar.
+
+``fit`` drives it through four hooks (all no-ops without a controller):
+``attach`` once before the epoch loop (auto-resume from the latest
+committed fence), ``on_epoch_start`` per epoch (mid-epoch fast-forward +
+metric restore when resuming), ``on_step`` per dispatched step (fault
+injection, periodic fence checkpoint, failure-monitor poll — raising
+:class:`ReconfigureSignal` after draining in-flight steps when liveness
+changed), and ``handle_reconfigure`` when that signal unwinds the epoch
+(re-form the mesh on the survivors, restore the last fence, hand back the
+resume epoch).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+
+__all__ = ["ElasticController", "ReconfigureSignal", "from_env"]
+
+log = logging.getLogger(__name__)
+
+
+class ReconfigureSignal(Exception):
+    """Raised out of the epoch body when the failure monitor reports a
+    liveness transition; carries the
+    :class:`~mxnet_tpu.parallel.health.ReconfigEvent`.  In-flight steps
+    are drained BEFORE this is raised, so nothing is outstanding when the
+    mesh re-forms."""
+
+    def __init__(self, event):
+        super().__init__(str(event))
+        self.event = event
+
+
+def _metric_leaves(metric):
+    from ..metric import DeviceMetricAccumulator
+
+    return DeviceMetricAccumulator._flatten(metric)
+
+
+class ElasticController:
+    """Compose a :class:`~mxnet_tpu.elastic.Checkpointer`, an optional
+    :class:`~mxnet_tpu.parallel.health.FailureMonitor` and an optional
+    :class:`~mxnet_tpu.elastic.FaultInjector` into the fit loop."""
+
+    def __init__(self, checkpointer=None, monitor=None, injector=None,
+                 poll_every=None):
+        from .. import config as _config
+
+        self.checkpointer = checkpointer
+        self.monitor = monitor
+        self.injector = injector
+        self.poll_every = max(1, int(_config.get("MXNET_ELASTIC_POLL")
+                                     if poll_every is None else poll_every))
+        self.global_step = 0
+        self.recoveries = 0
+        self._resume_meta = None
+        self._replay_epochs = 0   # cold resume: prior-epoch iterator replay
+        self._metric = None
+        self._full_contexts = None
+        self._full_mesh_config = None
+
+    # ------------------------------------------------------------------
+    # fit wiring
+    # ------------------------------------------------------------------
+    def attach(self, module, eval_metric, begin_epoch):
+        """Bind to the fitting module; auto-resume from the latest
+        committed fence when the checkpointer allows it.  Returns the
+        (possibly advanced) begin epoch."""
+        from .. import profiler as _prof
+
+        if getattr(module, "_exec_group", None) is None:
+            raise MXNetError("elastic training needs a bound Module-style "
+                             "driver (executor-group state is what the "
+                             "fence snapshots)")
+        self._metric = eval_metric
+        # the FULL roster: regrow re-forms over these even after a shrink
+        self._full_contexts = list(module._context)
+        self._full_mesh_config = module._mesh_config
+        ck = self.checkpointer
+        if ck is None:
+            return begin_epoch
+        if not ck.resume and ck.latest() is not None:
+            # refusing to mix lineages: with resume off, this run's
+            # low-numbered fences would lose every restore/prune decision
+            # to the previous run's higher step numbers — a mid-fit
+            # recovery would silently splice the OLD run's params/RNG in
+            raise MXNetError(
+                "MXNET_CKPT_RESUME=0 but %s already holds committed "
+                "checkpoints from a previous run; point MXNET_CKPT_DIR "
+                "at a fresh directory (or clear this one) to start over"
+                % ck.directory)
+        if ck.resume:
+            peeked = ck.peek()
+            if peeked is not None and int(peeked["epoch"]) < begin_epoch:
+                # restoring a mid-epoch-2 fence into a begin_epoch=5 run
+                # would graft params/RNG onto an epoch no uninterrupted
+                # run could pair them with — refuse rather than corrupt
+                raise MXNetError(
+                    "checkpoint in %s is at epoch %d, behind the "
+                    "requested begin_epoch %d; clear the directory or "
+                    "lower begin_epoch" % (ck.directory,
+                                           int(peeked["epoch"]),
+                                           begin_epoch))
+            meta = ck.restore(module)
+            if meta is not None:
+                self.global_step = int(meta["global_step"])
+                self._resume_meta = meta
+                # cold resume: the training iterator is freshly built, so
+                # its prior-epoch lifecycle must be replayed (roll_over
+                # reset carries state) — unlike a mid-fit reconfigure,
+                # whose iterator lived through those epochs already
+                self._replay_epochs = int(meta["epoch"])
+                self.recoveries += 1
+                _prof.bump_recovery()
+                log.info("elastic resume: step %d (epoch %d, %d batches "
+                         "into it) from %s", self.global_step,
+                         meta["epoch"], meta["nbatch_done"], ck.directory)
+                return max(begin_epoch, int(meta["epoch"]))
+        if ck.latest() is None:
+            # an initial fence so a failure before the first periodic one
+            # still has a restore point (fresh params, step 0)
+            ck.snapshot(module, self._meta(module, begin_epoch, 0))
+        return begin_epoch
+
+    def on_epoch_start(self, module, epoch, train_data, eval_metric):
+        """Mid-epoch resume: restore metric sums to the fence values and
+        fast-forward the (freshly reset) iterator.  Returns the batch
+        index the epoch continues from (0 normally)."""
+        meta, self._resume_meta = self._resume_meta, None
+        if meta is None or int(meta["epoch"]) != epoch:
+            return 0
+        self._restore_metric(eval_metric, meta)
+        # cold resume only, stateful-reset iterators only: replay the
+        # fresh iterator's prior-epoch LIFECYCLE — reset() may depend on
+        # the position earlier epochs reached (NDArrayIter roll_over
+        # carries the tail cursor across reset), so each prior epoch is
+        # drained and reset exactly as the uninterrupted run did before
+        # the mid-epoch cursor is restored.  Stateless-reset iterators
+        # (`reset_carries_state` False — pad/discard, RecordIO readers)
+        # reproduce the same position from one reset + fast_forward, so
+        # they skip the O(epochs x dataset) drain.  A mid-fit
+        # reconfigure skips it too: its iterator lived through those
+        # epochs already.
+        replay, self._replay_epochs = self._replay_epochs, 0
+        if not getattr(train_data, "reset_carries_state", False):
+            replay = 0
+        for _ in range(replay):
+            try:
+                while True:
+                    train_data.next()
+            except StopIteration:
+                pass
+            train_data.reset()
+        # the fence's iterator-cursor record: batches the interrupted
+        # epoch had consumed (== nbatch_done; kept under its own key so
+        # richer iterator state can ride the same record later)
+        n = int((meta.get("iterator") or {}).get("batches_done",
+                                                 meta["nbatch_done"]))
+        if n:
+            if hasattr(train_data, "fast_forward"):
+                train_data.fast_forward(n)
+            else:
+                for _ in range(n):
+                    train_data.next()
+        return n
+
+    def on_step(self, module, epoch, nbatch, fences):
+        """Once per dispatched step, on the loop thread."""
+        self.global_step += 1
+        step = self.global_step
+        if self.injector is not None:
+            # faults fire BEFORE this step's fence work: "killed at N"
+            # means N's checkpoint never happened, like a real death
+            self.injector.fire(step)
+        ck = self.checkpointer
+        if ck is not None:
+            ck.note_step()
+            if ck.period and step % ck.period == 0:
+                ck.snapshot(module, self._meta(module, epoch, nbatch + 1))
+        if self.monitor is not None and step % self.poll_every == 0:
+            event = self.monitor.poll()
+            if event is not None:
+                self._drain(fences)
+                raise ReconfigureSignal(event)
+
+    def handle_reconfigure(self, module, signal, eval_metric):
+        """Re-form the mesh on the survivors and restore the last fence.
+        Returns the epoch to resume from."""
+        from .. import profiler as _prof
+        from ..parallel import mesh as mesh_mod
+
+        if self.monitor is None:
+            raise MXNetError("reconfiguration without a failure monitor")
+        ck = self.checkpointer
+        if ck is not None:
+            ck.wait()
+        event = signal.event
+        num_workers = self.monitor.num_workers
+        survivors = [r for r in range(num_workers)
+                     if r not in set(event.dead)]
+        devs, cfg = mesh_mod.survivor_submesh(
+            self._full_contexts, num_workers, survivors,
+            self._full_mesh_config)
+        log.warning("elastic %s: dead=%s -> re-forming mesh on %d/%d "
+                    "devices (data axis %d)", event.kind, event.dead,
+                    len(devs), len(self._full_contexts), cfg.data)
+        module.reconfigure(devs, cfg if len(devs) > 1 else None)
+        # the rebuilt fused step needs the metric re-armed
+        module._bind_metric(eval_metric)
+        self.recoveries += 1
+        _prof.bump_recovery()
+        if ck is None:
+            raise MXNetError("reconfiguration without a checkpointer: the "
+                             "re-formed mesh has no state to resume from")
+        meta = ck.restore(module)
+        if meta is None:
+            raise MXNetError("no committed fence checkpoint in %s to "
+                             "resume the re-formed mesh from"
+                             % ck.directory)
+        self.global_step = int(meta["global_step"])
+        self._resume_meta = meta
+        # the abandoned epoch's mid-stream reset() leaves stateful-reset
+        # iterators (roll_over) at the fresh-construction position, NOT
+        # at the epoch's true start — replay the lifecycle for them just
+        # like a cold resume (stateless iterators skip it either way)
+        self._replay_epochs = int(meta["epoch"])
+        return int(meta["epoch"])
+
+    def finish(self):
+        """fit() teardown: join any in-flight write."""
+        if self.checkpointer is not None:
+            self.checkpointer.wait()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _drain(fences):
+        """Block until every dispatched step has completed (steps chain
+        through donated params, so the newest fence covers all)."""
+        if fences:
+            import jax
+
+            from .. import profiler as _prof
+            import time
+
+            t0 = time.perf_counter()
+            jax.block_until_ready(fences[-1])
+            _prof.record_host_wait(time.perf_counter() - t0)
+            fences.clear()
+
+    def _meta(self, module, epoch, nbatch_done):
+        meta = {"epoch": int(epoch), "nbatch_done": int(nbatch_done),
+                "global_step": int(self.global_step),
+                "iterator": {"batches_done": int(nbatch_done)}}
+        opt = getattr(module, "_optimizer", None)
+        if opt is not None:
+            # the optimizer's update counts drive Adam bias correction and
+            # lr schedules: a mid-stream replay with t reset to 0 would
+            # NOT be bit-identical
+            meta["optimizer"] = {
+                "num_update": int(opt.num_update),
+                "begin_num_update": int(opt.begin_num_update),
+                "index_update_count": {
+                    str(k): int(v)
+                    for k, v in opt._index_update_count.items()}}
+        if self._metric is not None:
+            # raw sums, NOT the draining properties — reading sum_metric
+            # would force the device accumulator d2h onto the hot loop;
+            # the device half rides the snapshot as array copies instead
+            meta["metric_host"] = [
+                {"sums": [float(s) for s in m._sums],
+                 "counts": [float(c) for c in m._counts]}
+                for m in _metric_leaves(self._metric)]
+        return meta
+
+    @staticmethod
+    def _restore_metric(metric, meta):
+        host = meta.get("metric_host")
+        if host is None or metric is None:
+            return
+        leaves = _metric_leaves(metric)
+        if len(leaves) != len(host):
+            log.warning("checkpointed metric has %d leaves, live metric "
+                        "%d; skipping metric restore", len(host),
+                        len(leaves))
+            return
+        dev = meta.get("metric_device") or [None] * len(leaves)
+        for m, h, d in zip(leaves, host, dev):
+            sums = [float(x) for x in h["sums"]]
+            counts = [float(x) for x in h["counts"]]
+            if d:
+                # fold the fence's pending device sums exactly as a drain
+                # would have (same additions, same order)
+                sums = [s + float(ds) for s, ds in zip(sums, d[0])]
+                counts = [c + float(dc) for c, dc in zip(counts, d[1])]
+            m._sums = sums
+            m._counts = [int(c) if float(c).is_integer() else c
+                         for c in counts]
+
+
+def from_env():
+    """An :class:`ElasticController` from the environment knobs, or None.
+
+    ``MXNET_CKPT_DIR`` + ``MXNET_CKPT_PERIOD`` arm fit-integrated fenced
+    checkpointing with auto-resume; liveness monitoring stays explicit
+    (construct a FailureMonitor and pass a controller) because only the
+    launcher knows the worker roster."""
+    from .. import config as _config
+
+    directory = _config.get("MXNET_CKPT_DIR")
+    if not directory or not int(_config.get("MXNET_CKPT_PERIOD")):
+        return None
+    from .checkpointer import Checkpointer
+
+    return ElasticController(checkpointer=Checkpointer(directory))

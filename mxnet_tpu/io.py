@@ -75,6 +75,15 @@ class DataBatch:
 class DataIter:
     """Iterator base (reference: io.py:92)."""
 
+    # whether reset() depends on the position earlier epochs reached
+    # (NDArrayIter roll_over carries the tail cursor across reset).  The
+    # elastic cold-resume path replays the prior-epoch drain/reset
+    # lifecycle ONLY for iterators flagging this — stateless-reset
+    # iterators reproduce every epoch from one reset, so the replay would
+    # be pure O(epochs x dataset) startup waste.  Wrappers delegate to
+    # their source.
+    reset_carries_state = False
+
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
 
@@ -107,6 +116,21 @@ class DataIter:
 
     def getpad(self):
         raise NotImplementedError()
+
+    def fast_forward(self, n):
+        """Advance ``n`` batches from the current position, as if they had
+        been consumed — the elastic-resume cursor restore (a fence
+        checkpoint records how many batches the interrupted epoch served;
+        see docs/elasticity.md).  The base implementation draws and
+        discards, which replays deterministically for EVERY iterator —
+        RecordIO readers and bucketed iterators included — since epoch
+        order is fixed at reset; seekable iterators override it with an
+        O(1) cursor jump.  Background-thread wrappers (PrefetchingIter /
+        DevicePrefetchIter) inherit the draining form on purpose: their
+        source is already ahead by the read-ahead depth, so the queue is
+        the only honest place to count consumed batches from."""
+        for _ in range(int(n)):
+            self.next()
 
 
 class NDArrayIter(DataIter):
@@ -186,6 +210,29 @@ class NDArrayIter(DataIter):
                 self.cursor + self.batch_size > self.num_data:
             return self.cursor + self.batch_size - self.num_data
         return 0
+
+    @property
+    def reset_carries_state(self):
+        # roll_over's reset() folds the over-run cursor back in; pad and
+        # discard always restart the epoch from the top
+        return self.last_batch_handle == "roll_over"
+
+    def fast_forward(self, n):
+        """O(1) cursor jump: ``n`` batches forward is exactly ``n``
+        ``iter_next`` increments (the epoch's sample order is fixed at
+        construction)."""
+        self.cursor += int(n) * self.batch_size
+
+    def checkpoint_state(self):
+        """The seekable cursor as a dict — the primitive ``fast_forward``
+        is built on, exposed for custom training loops that snapshot and
+        seek the iterator directly (the elastic fit path records a batch
+        COUNT instead, because its prefetch wrappers read ahead of the
+        consumed position)."""
+        return {"cursor": int(self.cursor)}
+
+    def restore_state(self, state):
+        self.cursor = int(state["cursor"])
 
 
 def _init_data(data, allow_empty, default_name):
@@ -277,6 +324,13 @@ class MNISTIter(DataIter):
     def iter_next(self):
         return self._inner.iter_next()
 
+    def fast_forward(self, n):
+        self._inner.fast_forward(n)
+
+    @property
+    def reset_carries_state(self):
+        return self._inner.reset_carries_state
+
 
 def _read_idx(path):
     if not os.path.exists(path) and os.path.exists(path + ".gz"):
@@ -336,6 +390,13 @@ class CSVIter(DataIter):
     def next(self):
         return self._inner.next()
 
+    def fast_forward(self, n):
+        self._inner.fast_forward(n)
+
+    @property
+    def reset_carries_state(self):
+        return self._inner.reset_carries_state
+
 
 class ResizeIter(DataIter):
     """Resize any iterator to a fixed number of batches per epoch
@@ -357,6 +418,11 @@ class ResizeIter(DataIter):
         self.cur = 0
         if self.reset_internal:
             self.data_iter.reset()
+
+    @property
+    def reset_carries_state(self):
+        # without the internal reset the source keeps rolling regardless
+        return self.data_iter.reset_carries_state or not self.reset_internal
 
     def iter_next(self):
         if self.cur == self.size:
@@ -510,6 +576,10 @@ class PrefetchingIter(_BackgroundIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
+    @property
+    def reset_carries_state(self):
+        return any(i.reset_carries_state for i in self.iters)
+
     def _produce(self):
         batches = [i.next() for i in self.iters]
         if self.n_iter == 1:
@@ -588,6 +658,10 @@ class DevicePrefetchIter(_BackgroundIter):
     @property
     def provide_label(self):
         return self.data_iter.provide_label
+
+    @property
+    def reset_carries_state(self):
+        return self.data_iter.reset_carries_state
 
     def _place_list(self, kind, arrs):
         if not arrs:

@@ -49,6 +49,9 @@ class BaseModule:
         self.optimizer_initialized = False
         self._symbol = None
         self._total_exec_bytes = 0
+        # fault-tolerance sidecar (mxnet_tpu.elastic.ElasticController),
+        # armed by fit() for the duration of a training run
+        self._elastic = None
 
     # ------------------------------------------------------------------
     # High-level interface
@@ -214,6 +217,11 @@ class BaseModule:
         limit = max(1, int(_config.get("MXNET_MAX_STEPS_IN_FLIGHT")))
         fences = deque()
         nbatch = 0
+        if self._elastic is not None:
+            # resuming into this epoch: metric sums back to the fence
+            # values, iterator fast-forwarded past the already-done batches
+            nbatch = self._elastic.on_epoch_start(self, epoch, train_data,
+                                                  eval_metric)
         it = iter(train_data)
         # MXNET_TRANSFER_GUARD arms jax's device->host transfer guard for
         # the whole epoch body: with device-side metrics + prefetch + the
@@ -255,6 +263,13 @@ class BaseModule:
                 _prof.record_step()
                 _fire(batch_end_callback,
                       BatchEndParam(epoch, nbatch, eval_metric, locals()))
+                if self._elastic is not None:
+                    # fault injection, the periodic fence checkpoint, and
+                    # the liveness poll (which drains `fences` and raises
+                    # ReconfigureSignal when the mesh must re-form).  After
+                    # the callback, so user callbacks observe every
+                    # completed batch exactly once even across a resume.
+                    self._elastic.on_step(self, epoch, nbatch, fences)
                 nbatch += 1
         if fences:
             # steps chain through donated params, so the newest fence
@@ -272,9 +287,19 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
+            monitor=None, elastic=None):
         """Train for ``num_epoch`` epochs: compiled train steps per batch,
-        optional validation pass and checkpoints per epoch."""
+        optional validation pass and checkpoints per epoch.
+
+        ``elastic`` is an optional
+        :class:`~mxnet_tpu.elastic.ElasticController` (auto-created from
+        ``MXNET_CKPT_DIR``/``MXNET_CKPT_PERIOD`` when unset): async fenced
+        checkpoints at step boundaries, auto-resume from the last
+        committed fence, and — with a failure monitor — mid-fit mesh
+        shrink/regrow on heartbeat transitions (docs/elasticity.md).
+        """
+        from .. import elastic as elastic_mod
+
         assert num_epoch is not None, "please specify number of epochs"
         self.prepare_fit(train_data, initializer=initializer,
                          arg_params=arg_params, aux_params=aux_params,
@@ -289,16 +314,46 @@ class BaseModule:
         # drivers/configs without a fused step)
         self._bind_metric(eval_metric)
         fit_data = self._wrap_train_data(train_data)
+        if elastic is None:
+            elastic = elastic_mod.from_env()
+            if elastic is not None and \
+                    getattr(self, "_exec_group", None) is None:
+                # env-armed checkpointing on a driver without executor-
+                # group state to fence (Bucketing/Sequential/Python
+                # modules): train WITHOUT checkpoints rather than abort —
+                # the env knobs are ambient, not a per-call opt-in.  An
+                # explicitly passed controller still fails loudly.
+                self.logger.warning(
+                    "MXNET_CKPT_DIR is set but %s has no executor-group "
+                    "state to fence; training without elastic "
+                    "checkpoints", type(self).__name__)
+                elastic = None
+        self._elastic = elastic
+        if elastic is not None:
+            # auto-resume: a committed fence in the checkpoint directory
+            # restores params/slots/RNG and advances the starting epoch
+            begin_epoch = elastic.attach(self, eval_metric, begin_epoch)
 
         try:
-            for epoch in range(begin_epoch, num_epoch):
-                if epoch > begin_epoch:
+            epoch = begin_epoch
+            first_epoch = True
+            while epoch < num_epoch:
+                if not first_epoch:
                     # reset at epoch START: after the last epoch there is
                     # no reset, so a prefetching wrapper's worker is not
                     # restarted just to have its read-ahead thrown away
                     fit_data.reset()
-                cost = self._fit_epoch(epoch, fit_data, eval_metric,
-                                       batch_end_callback, monitor)
+                first_epoch = False
+                try:
+                    cost = self._fit_epoch(epoch, fit_data, eval_metric,
+                                           batch_end_callback, monitor)
+                except elastic_mod.ReconfigureSignal as sig:
+                    # a heartbeat transition: in-flight steps are already
+                    # drained; re-form the mesh on the survivors, restore
+                    # the last fence, and continue from its epoch
+                    epoch = elastic.handle_reconfigure(self, sig,
+                                                       eval_metric)
+                    continue
                 # reading the metric drains any pending device accumulation
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -319,7 +374,11 @@ class BaseModule:
                             epoch=epoch):
                         self.logger.info("Epoch[%d] Validation-%s=%f",
                                          epoch, name, val)
+                epoch += 1
         finally:
+            if elastic is not None:
+                elastic.finish()
+            self._elastic = None
             if fit_data is not train_data and hasattr(fit_data, "close"):
                 fit_data.close()
             # fit() leaves the caller's iterator fresh (the pre-async loop

@@ -273,6 +273,33 @@ class Module(BaseModule):
         self._label_shapes = list(label_shapes) if label_shapes else None
         self._exec_group.reshape(self._data_shapes, self._label_shapes)
 
+    def reconfigure(self, contexts, mesh_config=None):
+        """Re-form the module over a new device set mid-training — the
+        elastic shrink/regrow step (mxnet_tpu.elastic).
+
+        Rebinds at the SAME data/label shapes on the new contexts/mesh
+        (the global batch is unchanged; each surviving device simply owns
+        a larger slice of the 'data' axis) and re-initializes the
+        optimizer so a fresh fused step compiles against the new executor
+        group.  The caller then restores params/slots from the last fence
+        checkpoint, re-sharded onto the new mesh — nothing may be in
+        flight when this runs (the elastic controller drains first)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        if isinstance(contexts, ctx_mod.Context):
+            contexts = [contexts]
+        data_shapes, label_shapes = self._data_shapes, self._label_shapes
+        optimizer = self._optimizer
+        self._context = list(contexts)
+        self._mesh_config = mesh_config
+        self.bind(data_shapes=data_shapes, label_shapes=label_shapes,
+                  for_training=True, force_rebind=True)
+        # bind() pushed the host param dicts into the new group; the fused
+        # step (fresh zero-moment slots) rebuilds here and the fence
+        # restore that follows overwrites both
+        self.init_optimizer(kvstore="local", optimizer=optimizer,
+                            force_init=True)
+
     # ------------------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

@@ -7,11 +7,12 @@ path (executor_group) and the standalone training-step API (models/).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 import numpy as np
 
-__all__ = ["MeshConfig", "build_mesh", "data_parallel_mesh"]
+__all__ = ["MeshConfig", "build_mesh", "data_parallel_mesh",
+           "rank_devices", "survivor_submesh"]
 
 
 @dataclass
@@ -60,3 +61,62 @@ def build_mesh(config=None, devices=None):
 
 def data_parallel_mesh(devices=None):
     return build_mesh(MeshConfig(data=-1), devices)
+
+
+# ---------------------------------------------------------------------------
+# Elastic reconfiguration (mxnet_tpu.elastic): the 'data' axis is the
+# worker-ownership axis — rank r owns a contiguous block of data-axis rows,
+# and every other axis (model/pipe/seq/expert) lives entirely within one
+# worker's devices.  Shrinking on failure therefore means dropping the dead
+# ranks' data rows and re-forming the mesh over the survivors' devices;
+# regrowing is the same computation with the returned ranks back in.
+# ---------------------------------------------------------------------------
+
+def rank_devices(devices, num_workers, config=None):
+    """Partition ``devices`` into per-rank slices along the data axis.
+
+    The mesh layout is row-major over (data, model, pipe, seq, expert), so
+    each data-axis row is a contiguous run of ``len(devices)/data`` devices
+    and rank ``r`` owns rows ``[r*data/W, (r+1)*data/W)``.  Returns a list
+    of ``num_workers`` device lists."""
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    data = sizes[config.names.index("data")]
+    if data % num_workers != 0:
+        raise ValueError("data axis %d not divisible by %d workers"
+                         % (data, num_workers))
+    rows_per = data // num_workers
+    block = len(devices) // data          # devices per data-axis row
+    per = rows_per * block
+    return [list(devices[r * per:(r + 1) * per]) for r in range(num_workers)]
+
+
+def survivor_submesh(devices, num_workers, survivors, config=None):
+    """Devices + shrunk MeshConfig for the surviving worker ranks.
+
+    ``devices`` is the FULL original device (or context) list the mesh was
+    built over; ``survivors`` the ranks still alive.  The returned config
+    keeps every non-data axis and scales 'data' to the survivors' share —
+    the per-replica batch grows by the same factor, the global batch stays
+    fixed.  Passing all ranks back reproduces the original mesh (regrow).
+    """
+    survivors = sorted(set(survivors))
+    if not survivors:
+        raise ValueError("no surviving workers to re-form the mesh on")
+    config = config or MeshConfig()
+    parts = rank_devices(devices, num_workers, config)
+    sizes = config.resolve(len(devices))
+    data = sizes[config.names.index("data")]
+    rows_per = data // num_workers
+    devs = []
+    for r in survivors:
+        if r >= num_workers:
+            raise ValueError("survivor rank %d out of range (%d workers)"
+                             % (r, num_workers))
+        devs.extend(parts[r])
+    # pin every axis to its RESOLVED size (a -1 in the original config must
+    # not re-absorb the shrunk device count into the wrong axis)
+    resolved = dict(zip(config.names, sizes))
+    resolved["data"] = rows_per * len(survivors)
+    new_cfg = replace(config, **resolved)
+    return devs, new_cfg

@@ -18,7 +18,8 @@ import threading
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "Scope", "start", "stop", "record_host_wait", "record_input_wait",
            "record_step", "bump_metric_d2h", "bump_metric_sync",
-           "record_request", "step_stats", "reset_step_stats"]
+           "record_request", "record_ckpt_stall", "record_ckpt_write",
+           "bump_recovery", "step_stats", "reset_step_stats"]
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "events": [], "jax_trace_dir": None}
@@ -33,9 +34,13 @@ _lock = threading.Lock()
 # transfers MXNET_METRIC_SYNC_PERIOD exists to eliminate.
 # ---------------------------------------------------------------------------
 _STEP_KEYS = ("steps", "host_wait_s", "input_wait_s", "metric_d2h",
-              "metric_syncs")
+              "metric_syncs", "ckpt_stall_s", "ckpt_writes", "last_ckpt_ms",
+              "recoveries")
+_FLOAT_STEP_KEYS = ("host_wait_s", "input_wait_s", "ckpt_stall_s",
+                    "last_ckpt_ms")
 _step = dict.fromkeys(_STEP_KEYS, 0)
-_step["host_wait_s"] = _step["input_wait_s"] = 0.0
+for _k in _FLOAT_STEP_KEYS:
+    _step[_k] = 0.0
 _step["t0"] = time.time()
 
 # Per-request serving records (decode.DecodeServer retirements): each is a
@@ -93,6 +98,33 @@ def bump_metric_sync(n=1):
         _step["metric_syncs"] += n
 
 
+def record_ckpt_stall(seconds):
+    """Time the training loop's host thread spent on checkpointing work
+    (elastic fence snapshot + write submission; the ENTIRE save when
+    MXNET_CKPT_ASYNC=0).  Feeds ``checkpoint_stall_fraction`` in
+    ``step_stats`` — the number async fenced checkpointing exists to
+    drive toward zero."""
+    with _lock:
+        _step["ckpt_stall_s"] += seconds
+        _span("ckpt_stall", time.time() - seconds, seconds)
+
+
+def record_ckpt_write(ms):
+    """One committed fence checkpoint written (by the writer thread or
+    inline): duration in milliseconds."""
+    with _lock:
+        _step["ckpt_writes"] += 1
+        _step["last_ckpt_ms"] = float(ms)
+        _span("ckpt_write", time.time() - ms / 1e3, ms / 1e3)
+
+
+def bump_recovery(n=1):
+    """n elastic recovery events (resume-from-checkpoint at startup, or a
+    mid-fit mesh shrink/regrow reconfiguration)."""
+    with _lock:
+        _step["recoveries"] += n
+
+
 def record_request(queue_wait_s, ttft_s, tokens, decode_s):
     """One served request retired (decode.DecodeServer): time queued
     before admission, time to first token (from submit), tokens
@@ -114,7 +146,8 @@ def reset_step_stats():
     with _lock:
         for k in _STEP_KEYS:
             _step[k] = 0
-        _step["host_wait_s"] = _step["input_wait_s"] = 0.0
+        for k in _FLOAT_STEP_KEYS:
+            _step[k] = 0.0
         _step["t0"] = time.time()
         del _requests[:]
 
@@ -143,6 +176,7 @@ def step_stats():
         }
     out["input_stall_fraction"] = min(out["input_wait_s"] / wall, 1.0)
     out["host_wait_fraction"] = min(out["host_wait_s"] / wall, 1.0)
+    out["checkpoint_stall_fraction"] = min(out["ckpt_stall_s"] / wall, 1.0)
     steps = max(out["steps"], 1)
     out["host_syncs_per_step"] = out["metric_d2h"] / steps
     return out

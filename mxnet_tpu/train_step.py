@@ -262,8 +262,7 @@ class CompiledTrainStep:
         self.params = {n: jnp.copy(exe.arg_dict[n].data)
                        for n in self._param_names}
         self.aux = {n: jnp.copy(exe.aux_dict[n].data) for n in self._aux_names}
-        self.slots = {n: self._make_slots(self.params[n])
-                      for n in self._grad_names}
+        self.reset_slots()
         # compiled programs keyed by executor identity (the value holds a
         # strong ref to the executor so a GC'd id can't alias a new one);
         # a reshape rebuilds group.exec_, so the stale program is skipped
@@ -696,6 +695,13 @@ class CompiledTrainStep:
         if isinstance(state, (tuple, list)):
             return tuple(leaf(s) for s in state)
         return (leaf(state),)
+
+    def reset_slots(self):
+        """Synthesize fresh (zero-moment) optimizer slots for the CURRENT
+        params — a slot-less checkpoint restored into a training module
+        must not keep the moments of the weights it replaced."""
+        self.slots = {n: self._make_slots(self.params[n])
+                      for n in self._grad_names}
 
     def import_updater_states(self, states, param_names):
         """Seed slots from an eager Updater's state dict (index- or

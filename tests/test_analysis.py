@@ -306,6 +306,37 @@ def test_host_sync_pass_catches_pure_callback():
     assert any(f.code == "pure_callback" for f in rep.errors)
 
 
+def test_host_sync_pass_sanctioned_allowlist():
+    """An artifact may declare intentional host transfers
+    (meta['host_sync_allow'] — the elastic fence-d2h mechanism): matching
+    findings downgrade to visible info rows instead of errors, while
+    unlisted codes still fail."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaky(x):
+        jax.debug.print("x={x}", x=x.sum())
+        return x * 2
+
+    art = artifact_from_jit(jax.jit(leaky),
+                            (jax.ShapeDtypeStruct((4,), jnp.float32),),
+                            name="fence", compile_program=False,
+                            host_sync_allow=["debug_callback"])
+    rep = run_passes([art], passes=[HostSyncPass()])
+    assert rep.errors == []
+    sanc = [f for f in rep.findings
+            if f.code == "sanctioned:debug_callback"]
+    assert len(sanc) == 1 and sanc[0].severity == "info", rep.findings
+    # the waiver is code-specific: a different leak is still an error
+    art2 = artifact_from_jit(jax.jit(leaky),
+                             (jax.ShapeDtypeStruct((4,), jnp.float32),),
+                             name="fence2", compile_program=False,
+                             host_sync_allow=["hlo-outfeed"])
+    rep2 = run_passes([art2], passes=[HostSyncPass()])
+    assert len(rep2.errors) == 1
+    assert rep2.errors[0].code == "debug_callback"
+
+
 def test_host_sync_pass_clean_program():
     import jax
     import jax.numpy as jnp

@@ -53,6 +53,114 @@ def test_heartbeat_restart_overwrites(tmp_path):
     assert health.dead_nodes(d, 4, timeout=5.0) == [0, 1, 2]
 
 
+def test_grace_for_unstamped_worker(tmp_path):
+    """A rank registered in the roster whose FIRST stamp is still pending
+    must not read as dead inside the grace window; a stamp that exists
+    but is stale is dead regardless of grace."""
+    d = str(tmp_path)
+    hb = health.Heartbeat(d, rank=0)   # creates the directory epoch
+    hb.beat()
+    now = time.time()
+    # rank 1 never stamped: dead without grace, alive within it
+    assert health.dead_nodes(d, 2, timeout=5.0, now=now) == [1]
+    assert health.dead_nodes(d, 2, timeout=5.0, now=now, grace=60.0) == []
+    # ... but once the grace window has passed, missing = dead again
+    assert health.dead_nodes(d, 2, timeout=1e6, now=now + 120.0,
+                             grace=60.0) == [1]
+    # a STALE stamp is dead even inside grace (grace covers startup,
+    # not silence)
+    health.Heartbeat(d, rank=1).beat()
+    assert 1 in health.dead_nodes(d, 2, timeout=5.0, now=now + 30.0,
+                                  grace=60.0)
+
+
+def test_failure_monitor_reports_transitions(tmp_path):
+    """poll() returns events only on liveness CHANGES: baseline first,
+    then shrink on a newly stale rank, then regrow on its return — and
+    never reports the monitor's own rank."""
+    d = str(tmp_path)
+    health.Heartbeat(d, rank=0).beat()
+    health.Heartbeat(d, rank=1).beat()
+    mon = health.FailureMonitor(d, num_workers=2, my_rank=0, timeout=1e6,
+                                grace=0)
+    assert mon.poll() is None          # baseline: everyone alive
+    assert mon.poll() is None          # no change
+    # backdate rank 1 (the FaultInjector's stale mechanism)
+    with open(os.path.join(d, "worker-1.heartbeat"), "w") as f:
+        json.dump({"rank": 1, "time": time.time() - 1e9, "pid": -1}, f)
+    ev = mon.poll()
+    assert ev is not None and ev.kind == "shrink"
+    assert ev.dead == [1] and ev.newly_dead == [1]
+    assert mon.poll() is None          # still dead: no new transition
+    health.Heartbeat(d, rank=1).beat()
+    ev = mon.poll()
+    assert ev is not None and ev.kind == "regrow"
+    assert ev.dead == [] and ev.returned == [1]
+    # the monitor's own rank is exempt even if its stamp vanishes
+    os.remove(os.path.join(d, "worker-0.heartbeat"))
+    assert mon.poll() is None
+
+
+def test_failure_monitor_first_poll_reports_already_dead(tmp_path):
+    """A rank that died between launch and the FIRST poll (e.g. while
+    step 0 compiled) must shrink immediately — not become an invisible
+    baseline whose later return fires a regrow for a shrink that never
+    happened."""
+    d = str(tmp_path)
+    health.Heartbeat(d, rank=0).beat()
+    with open(os.path.join(d, "worker-1.heartbeat"), "w") as f:
+        json.dump({"rank": 1, "time": time.time() - 1e9, "pid": -1}, f)
+    mon = health.FailureMonitor(d, num_workers=2, my_rank=0, timeout=1e6,
+                                grace=0)
+    ev = mon.poll()
+    assert ev is not None and ev.kind == "shrink" and ev.dead == [1]
+    assert mon.poll() is None
+
+
+def test_heartbeat_del_and_atexit_stop(tmp_path):
+    """Garbage collection and the atexit hook both stop the stamper
+    thread — a finished process must go stale, not beat forever.  The
+    worker holds only a weakref, so dropping the last reference really
+    collects the Heartbeat (a bound-method target would pin it)."""
+    import gc
+
+    d = str(tmp_path)
+    hb = health.Heartbeat(d, rank=7, interval=0.02).start()
+    t = hb._thread
+    assert t.is_alive()
+    del hb
+    gc.collect()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+
+    hb2 = health.ensure_heartbeat(d, 8, interval=0.02)
+    t2 = hb2._thread
+    assert t2.is_alive()
+    health._stop_all_heartbeats()      # the registered atexit hook
+    assert hb2._thread is None
+    t2.join(timeout=2.0)
+    assert not t2.is_alive()
+
+
+def test_heartbeat_restart_after_stop(tmp_path):
+    """start() after stop() must actually stamp again (fresh stop event)
+    — a 'restarted' heartbeat that silently never beats would read as a
+    dead rank and shrink the mesh."""
+    d = str(tmp_path)
+    hb = health.Heartbeat(d, rank=2, interval=0.02).start()
+    hb.stop()
+    assert hb._thread is None
+    hb.start()
+    try:
+        assert hb._thread is not None and hb._thread.is_alive()
+        before = os.path.getmtime(os.path.join(d, "worker-2.heartbeat"))
+        time.sleep(0.1)
+        after = os.path.getmtime(os.path.join(d, "worker-2.heartbeat"))
+        assert after > before   # the restarted worker really stamps
+    finally:
+        hb.stop()
+
+
 def test_is_recovery_env(monkeypatch):
     monkeypatch.delenv("MXNET_IS_RECOVERY", raising=False)
     assert not health.is_recovery()

@@ -93,6 +93,69 @@ def test_restore_resumes_fused_training(tmp_path):
     _train_some(mod2, seed=4, epochs=1)
 
 
+def test_latest_step_skips_torn_checkpoint(tmp_path):
+    """A crash mid-save leaves an uncommitted step directory; it must
+    never become the 'latest' and poison resume — only directories that
+    reached the commit marker (or orbax finalize metadata) count."""
+    from mxnet_tpu.elastic import FaultInjector
+
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    _train_some(mod, epochs=1)
+    d = str(tmp_path / "ck")
+    checkpoint.save_sharded(d, 3, mod)
+    assert checkpoint.latest_step(d) == 3
+    # the torn debris of a crash at step 9 — higher step, no commit
+    torn = FaultInjector.torn_checkpoint(d, 9)
+    assert not checkpoint.is_committed(d, 9)
+    assert checkpoint.latest_step(d) == 3
+    # committing it (the marker is the LAST write of a real save) flips it
+    checkpoint.commit_step(torn)
+    assert checkpoint.latest_step(d) == 9
+    # the marker is the ONLY accepted evidence: orbax writes its own
+    # _CHECKPOINT_METADATA inside the renamed dir, so the debris of a
+    # crash between the rename and the marker carries it — it must NOT
+    # count (external checkpoints are adopted via commit_step instead)
+    import os
+
+    os.remove(os.path.join(torn, checkpoint.COMMIT_MARKER))
+    with open(os.path.join(torn, "_CHECKPOINT_METADATA"), "w") as f:
+        f.write("{}")
+    assert not checkpoint.is_committed(d, 9)
+    assert checkpoint.latest_step(d) == 3
+
+
+def test_slotless_restore_synthesizes_fresh_slots(tmp_path):
+    """inference -> train restore: a slot-less checkpoint loaded into a
+    training module must synthesize FRESH (zero-moment) optimizer slots
+    for the restored params — not keep the moments of the weights it just
+    replaced — and hand slot ownership to the fused step so a stale eager
+    updater cannot re-import the old ones."""
+    infer = mx.mod.Module(_net(), context=mx.cpu())
+    infer.bind(data_shapes=[("data", (16, 8))], for_training=False)
+    infer.init_params(mx.initializer.Xavier())
+    ref, _ = infer.get_params()
+    checkpoint.save_sharded(str(tmp_path / "ck"), 0, infer)
+
+    trained = mx.mod.Module(_net(), context=mx.cpu())
+    _train_some(trained, seed=2, epochs=1)
+    # adam moments are nonzero after training
+    assert any(np.abs(np.asarray(s[0])).max() > 0
+               for s in trained._fused_step.slots.values())
+    checkpoint.load_sharded(str(tmp_path / "ck"), 0, trained)
+    got, _ = trained.get_params()
+    for name in ref:
+        np.testing.assert_allclose(got[name].asnumpy(),
+                                   ref[name].asnumpy(), rtol=1e-6,
+                                   err_msg=name)
+    # slots synthesized fresh, ownership with the fused step
+    for name, slots in trained._fused_step.slots.items():
+        for s in slots:
+            assert np.abs(np.asarray(s)).max() == 0.0, name
+    assert trained._opt_owner == "fused"
+    # and training continues without error from the fresh moments
+    _train_some(trained, seed=4, epochs=1)
+
+
 def test_latest_step_empty(tmp_path):
     assert checkpoint.latest_step(str(tmp_path / "nope")) is None
     mod = mx.mod.Module(_net(), context=mx.cpu())

@@ -2,12 +2,13 @@
 """mxlint — static program-analysis lint over the framework's canonical
 compiled programs.
 
-Builds the ten canonical programs on the current backend (``--smoke``
+Builds the eleven canonical programs on the current backend (``--smoke``
 forces the 8-virtual-device CPU platform so the ring×TP mesh program
 exists on one box; the speculative trio — draft_step / verify_step /
 decode_step_q — is driven by a real mixed-length speculative serve, and
 the paged pair — paged_decode_step / paged_verify_step — by a real
-shared-prefix paged serve), snapshots each as a
+shared-prefix paged serve, and ckpt_train_step by a real fit under async fenced
+checkpointing), snapshots each as a
 :class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` (jaxpr + lowered
 StableHLO + compiled HLO + donation/retrace/dtype/cache metadata), and
 runs the six analysis passes against the committed budget file:
