@@ -30,21 +30,6 @@ BASELINE_IMG_S = 181.53  # ResNet-50 train bs32, P100 (docs/how_to/perf.md:188)
 RESNET50_FWD_FLOPS = 4.1e9
 TRAIN_FLOPS_PER_IMG = 3 * RESNET50_FWD_FLOPS
 
-# peak bf16 FLOP/s per chip by TPU generation (public spec sheets)
-PEAK_FLOPS = {
-    "TPU v2": 45e12 / 2,      # per-chip: 2 cores, 22.5T each
-    "TPU v3": 123e12 / 2,
-    "TPU v4": 275e12,
-    "TPU v5e": 197e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6e": 918e12,
-    "TPU v6 lite": 918e12,
-    "TPU7x": 2307e12,
-}
-
-
 def contract_line(metric, value, unit, vs_baseline, **extra):
     """The one-line stdout JSON contract every bench emits — and now the
     analysis CLI too (tools/mxlint.py), so CI consumes one schema:
@@ -56,11 +41,12 @@ def contract_line(metric, value, unit, vs_baseline, **extra):
 
 
 def _peak_for(device):
-    kind = getattr(device, "device_kind", "")
-    for name, peak in PEAK_FLOPS.items():
-        if kind.lower().startswith(name.lower()):
-            return peak, kind
-    return None, kind
+    """(peak_flops_or_None, device_kind) — the spec-sheet table now lives
+    with the telemetry subsystem (obs.roofline.PEAK_FLOPS) so the bench
+    and the per-program MFU table share one map."""
+    from mxnet_tpu.obs.roofline import peak_flops_for
+
+    return peak_flops_for(device)
 
 
 def _make_recordio_dataset(n_images, tmpdir):
@@ -222,6 +208,14 @@ def main():
         "sustained_tflops": round(tflops, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
     }), file=sys.stderr)
+    # the per-program roofline join (obs.mfu_table): measured dispatch
+    # wall over the timed window vs static dot FLOPs / traffic bytes —
+    # the per-kernel view of the aggregate MFU above (tools/mxstat.py
+    # renders it; statically-counted FLOPs, not the analytic estimate)
+    from mxnet_tpu import obs
+
+    mfu_rows = obs.mfu_table()
+    print(obs.render_mfu_table(mfu_rows), file=sys.stderr)
     metric = "resnet50_train_imgs_per_sec_bs%d" % batch_size
     if use_recordio:
         metric = "resnet50_recordio_train_imgs_per_sec_bs%d" % batch_size
@@ -229,7 +223,8 @@ def main():
         metric, round(img_s, 2), "img/s",
         round(img_s / BASELINE_IMG_S, 3),
         input_stall_fraction=round(stats["input_stall_fraction"], 4),
-        host_syncs_per_step=round(stats["host_syncs_per_step"], 4)))
+        host_syncs_per_step=round(stats["host_syncs_per_step"], 4),
+        mfu_table=mfu_rows))
 
 
 def smoke():
@@ -239,7 +234,13 @@ def smoke():
     loop-accounting contract fields — including the elastic trio
     (checkpoint_stall_fraction / last_ckpt_ms / recoveries, whose
     deterministic halves tests/test_bench_contract.py pins: writes
-    happened, no recovery on a clean run)."""
+    happened, no recovery on a clean run) — plus the per-program
+    ``mfu_table`` roofline rows: the fit drives train_step, a score()
+    pass drives eval_step, and a tiny KV-cached generate drives
+    prefill + decode_step, so every canonical program the smoke touches
+    gets a row joining measured dispatch wall against static
+    FLOPs/bytes (flops, bytes, wall_s, mfu — mfu is null on the CPU
+    harness, where no spec peak exists)."""
     import shutil
     import tempfile
 
@@ -248,7 +249,7 @@ def smoke():
     jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
-    from mxnet_tpu import elastic, profiler
+    from mxnet_tpu import elastic, obs, profiler
 
     batch, steps_per_epoch, epochs = 32, 25, 2
     rng = np.random.RandomState(0)
@@ -273,6 +274,9 @@ def smoke():
                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
                 initializer=mx.initializer.Xavier(), elastic=ctl)
         toc = time.time()
+        # loop-accounting snapshot AT the fit boundary: the contract's
+        # stall fractions / host_syncs_per_step describe the fit, not
+        # the extra program drives below
         stats = profiler.step_stats()
         ckpt_writes = ctl.checkpointer.writes
         steps_during_write = ctl.checkpointer.steps_during_write
@@ -280,6 +284,21 @@ def smoke():
         shutil.rmtree(ckpt_dir, ignore_errors=True)
     if mod._fused_step is None:
         print("WARNING: fused train step not active", file=sys.stderr)
+
+    # eval_step row: one device-metric score() pass over the same data
+    mod.score(it, "acc")
+    # prefill/decode_step rows: a tiny KV-cached generate (the canonical
+    # attention-LM dims the analysis programs use)
+    from mxnet_tpu.analysis.programs import _lm_params, _lm_symbol
+    from mxnet_tpu.decode import DecodePredictor
+
+    sym = _lm_symbol()
+    pred = DecodePredictor(sym, _lm_params(sym, 2, 16), cache_len=16,
+                           temperature=0.0, kv_dtype="", paged=False)
+    pred.generate(rng.randint(0, 32, (2, 8)).astype(np.float32),
+                  prompt_len=8, max_new_tokens=5)
+    mfu_rows = obs.mfu_table()
+    print(obs.render_mfu_table(mfu_rows), file=sys.stderr)
     print(json.dumps({"loop_stats": {k: stats[k] for k in
                                      ("steps", "host_wait_s", "input_wait_s",
                                       "metric_d2h", "metric_syncs",
@@ -297,7 +316,8 @@ def smoke():
         last_ckpt_ms=round(stats["last_ckpt_ms"], 2),
         ckpt_writes=ckpt_writes,
         ckpt_steps_during_write=steps_during_write,
-        recoveries=stats["recoveries"]))
+        recoveries=stats["recoveries"],
+        mfu_table=mfu_rows))
 
 
 if __name__ == "__main__":

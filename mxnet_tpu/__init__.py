@@ -60,6 +60,7 @@ from . import rnn
 from . import parallel
 from . import analysis
 from . import checkpoint
+from . import obs
 from . import profiler
 from . import visualization
 from . import visualization as viz

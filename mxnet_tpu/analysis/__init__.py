@@ -34,6 +34,7 @@ import json
 import os
 
 from .artifact import ProgramArtifact, artifact_from_jit
+from .cost import aval_bytes, program_cost
 from .framework import (Finding, Pass, Report, SEVERITIES, default_passes,
                         run_passes)
 from .passes import (CacheBytesPass, CollectiveBudgetPass, DonationPass,
@@ -44,8 +45,8 @@ __all__ = [
     "CacheBytesPass", "CollectiveBudgetPass", "DonationPass", "Finding",
     "FlopDtypePass", "HostSyncPass", "Pass", "ProgramArtifact", "Report",
     "RetraceAuditor", "RetracePass", "SEVERITIES", "arg_signature",
-    "artifact_from_jit", "default_passes", "load_budgets",
-    "resolve_budgets_path", "run_passes", "signature_diff",
+    "artifact_from_jit", "aval_bytes", "default_passes", "load_budgets",
+    "program_cost", "resolve_budgets_path", "run_passes", "signature_diff",
 ]
 
 _DEFAULT_BUDGETS = os.path.join(

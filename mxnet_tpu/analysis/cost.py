@@ -1,0 +1,49 @@
+"""Static roofline cost of one compiled program — FLOPs + traffic bytes.
+
+The join key of the telemetry subsystem's per-program MFU table
+(``mxnet_tpu.obs.roofline``): the dispatch wrappers measure wall time,
+this module prices the program —
+
+* **FLOPs** from :func:`~mxnet_tpu.analysis.hlo_parse.dot_flops` over
+  the LOWERED StableHLO (what the program asked for, before backend
+  legalization — the same accounting the flop-dtype pass audits and the
+  decode bench's O(1)-in-prefix assertion uses);
+* **traffic bytes** as the sum of argument + output aval bytes through
+  :func:`~mxnet_tpu.analysis.hlo_parse.shape_bytes`'s width table
+  (f8/sub-byte aware — the same table that prices KV caches).  This is
+  the program's memory-traffic FLOOR: every operand read once, every
+  result written once; intermediates that spill past on-chip memory add
+  to it, so achieved-bytes/s against HBM peak is a lower bound.
+
+Everything here is trace+lower only — no compile, no execution, no
+device work — and runs at table time, never on a hot path.
+"""
+from __future__ import annotations
+
+__all__ = ["aval_bytes", "program_cost"]
+
+
+def aval_bytes(tree):
+    """Total bytes of every array leaf in ``tree`` (arrays or
+    ShapeDtypeStructs), sized through the analysis width table."""
+    import jax.tree_util as jtu
+
+    from .hlo_parse import shape_bytes, shape_str
+
+    return sum(shape_bytes(shape_str(leaf.shape, leaf.dtype))
+               for leaf in jtu.tree_leaves(tree))
+
+
+def program_cost(fn, args):
+    """``{"flops", "bytes"}`` of a ``jax.jit``-wrapped callable at
+    ``args`` (abstract or concrete): dot FLOPs from one trace→lower, and
+    arg+output bytes from the avals.  Callers holding trace-counting
+    instrumentation must arm their probing flag around this (the trace
+    here is a probe, same economics as ``artifact_from_jit``)."""
+    import jax
+
+    from .hlo_parse import dot_flops
+
+    flops = dot_flops(fn.trace(*args).lower().as_text())
+    out = jax.eval_shape(fn, *args)
+    return {"flops": int(flops), "bytes": int(aval_bytes((args, out)))}

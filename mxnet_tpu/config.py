@@ -307,6 +307,41 @@ register("MXNET_ELASTIC_GRACE", float, 30.0,
          "Startup allowance (seconds) for registered-but-not-yet-stamped "
          "workers: within this window of the heartbeat directory's epoch "
          "a missing first stamp does not read as dead.")
+register("MXNET_TELEMETRY", bool, True,
+         "Arm the unified telemetry subsystem (mxnet_tpu.obs): timed "
+         "dispatch wrappers on the compiled programs (the per-program "
+         "MFU/roofline table), always-on timeline spans and instant "
+         "events (bounded ring buffer), and the lazy static-cost "
+         "probers.  Purely host-side — compiled HLO is byte-identical "
+         "on or off (tests/test_obs.py pins it).  The step_stats loop "
+         "counters predate the subsystem and stay on regardless.")
+register("MXNET_TRACE_BUFFER", int, 65536,
+         "Capacity (events) of the always-on trace-timeline ring buffer "
+         "(mxnet_tpu.obs.timeline).  Oldest events are evicted first, so "
+         "an armed timeline costs bounded memory however long the "
+         "process lives; profiler.dump_profile exports whatever is "
+         "retained as Chrome-trace JSON.")
+register("MXNET_METRICS_EXPORT", str, "",
+         "Path for the metrics registry's JSON-lines snapshot exporter: "
+         "with MXNET_METRICS_EXPORT_PERIOD > 0, a background thread "
+         "appends one {ts, metrics} line per period.  Empty (default) = "
+         "no file export; the registry is still readable in-process "
+         "(obs.registry.snapshot) and over HTTP (MXNET_METRICS_PORT).")
+register("MXNET_METRICS_EXPORT_PERIOD", float, 0.0,
+         "Seconds between JSON-lines metric snapshots written to "
+         "MXNET_METRICS_EXPORT (0 = off).")
+register("MXNET_METRICS_PORT", int, 0,
+         "Serve the metrics registry over HTTP from decode.DecodeServer "
+         "(obs.MetricsServer, 127.0.0.1): /metrics is the Prometheus "
+         "text format, /metrics.json the snapshot, /trace the current "
+         "timeline as Chrome-trace JSON.  0 (default) = no server.")
+register("MXNET_PEAK_FLOPS", float, 0.0,
+         "Peak accelerator FLOP/s used as the MFU denominator in the "
+         "per-program roofline table (obs.mfu_table / bench.py "
+         "mfu_table / tools/mxstat.py).  0 (default) = look the device "
+         "kind up in the TPU spec table; unknown devices (the CPU "
+         "harness) then report mfu=null while flops/bytes/wall stay "
+         "populated.")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

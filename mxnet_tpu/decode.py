@@ -84,6 +84,7 @@ import numpy as np
 
 from .base import MXNetError
 from . import context as ctx_mod
+from . import obs as _obs
 from .registry import OpContext
 
 __all__ = ["DecodePredictor", "DecodeServer", "DecodeState",
@@ -318,6 +319,10 @@ class DecodePredictor:
                                       donate_argnums=donate)
         self._verify_shapes = set()   # distinct (B, k, has_q) driven
         self._prefill_fns = {}   # (B, P) -> jitted prefill program
+        # roofline telemetry: program name -> (jitted fn, arg avals),
+        # snapped once on the first dispatch so obs.programs can price
+        # the program lazily (trace+lower at TABLE time, off hot paths)
+        self._static_args = {}
         # jnp dummies reused every call (sample_tokens at temperature 0
         # never reads the key, but the jit signature keeps it)
         self._zero_key = jax.random.PRNGKey(0)
@@ -325,6 +330,42 @@ class DecodePredictor:
     @property
     def cache_len(self):
         return self._cache_len
+
+    # ------------------------------------------------------------------
+    # roofline telemetry (mxnet_tpu.obs) — host-side only: the compiled
+    # programs are byte-identical with telemetry on or off
+    # ------------------------------------------------------------------
+    def _roofline_register(self, name, fn, args):
+        """Snap ``args``' avals once and register a lazy static-cost
+        prober for program ``name`` (first dispatch only; later calls
+        are one dict hit)."""
+        if name in self._static_args or not _obs.enabled():
+            return
+        import weakref
+
+        import jax.tree_util as jtu
+
+        from .analysis.artifact import aval_of
+
+        self._static_args[name] = (fn, jtu.tree_map(aval_of, args))
+        # weakly bound: a collected predictor must not stay pinned (env
+        # params + snapped programs) by the process-global accounting
+        ref = weakref.ref(self)
+        _obs.programs.register_static(
+            name, lambda n=name, r=ref: (
+                r()._roofline_static(n) if r() is not None else None))
+
+    def _roofline_static(self, name):
+        """Price one snapped program (trace+lower only; probe-flagged so
+        the trace counters stay honest)."""
+        from .analysis.cost import program_cost
+
+        fn, args = self._static_args[name]
+        self._probing = True
+        try:
+            return program_cost(fn, args)
+        finally:
+            self._probing = False
 
     # ------------------------------------------------------------------
     # the shared graph walk (traced inside both programs)
@@ -787,8 +828,11 @@ class DecodePredictor:
         caller owns the host length vector (``lens_h``) and advances it
         by the returned activity."""
         state, tables, act = self.paged_prepare(state, lens_h, 1, active)
-        return self._decode_fn(self._env, state, tables, act,
-                               key if key is not None else self._zero_key)
+        args = (self._env, state, tables, act,
+                key if key is not None else self._zero_key)
+        self._roofline_register("paged_decode_step", self._decode_fn, args)
+        with _obs.program_span("paged_decode_step"):
+            return self._decode_fn(*args)
 
     def paged_verify(self, state, lens_h, draft_toks, draft_probs=None,
                      key=None, active=None):
@@ -801,9 +845,11 @@ class DecodePredictor:
                                                 active)
         self._verify_shapes.add((draft_toks.shape[0], int(k),
                                  draft_probs is not None))
-        return self._verify_fn(self._env, state, tables, act, draft_toks,
-                               draft_probs,
-                               key if key is not None else self._zero_key)
+        args = (self._env, state, tables, act, draft_toks, draft_probs,
+                key if key is not None else self._zero_key)
+        self._roofline_register("paged_verify_step", self._verify_fn, args)
+        with _obs.program_span("paged_verify_step"):
+            return self._verify_fn(*args)
 
     def _paged_prefill(self, tokens, prompt_len=None, key=None):
         """Paged prefill = chunked cached-forward, one row at a time:
@@ -868,12 +914,13 @@ class DecodePredictor:
             if copies:
                 caches = self._run_forks(caches, copies)
             key, sub = jax.random.split(key)
-            caches, probs, tok = self._chunk_fn(
-                self._env, caches,
-                jnp.asarray(mgr.tables[slot:slot + 1]),
-                jnp.asarray(_pad_window(prompt[pos:pos + n], w)),
-                jnp.asarray([pos], jnp.int32),
-                jnp.asarray([n], jnp.int32), sub)
+            with _obs.program_span("prefill"):
+                caches, probs, tok = self._chunk_fn(
+                    self._env, caches,
+                    jnp.asarray(mgr.tables[slot:slot + 1]),
+                    jnp.asarray(_pad_window(prompt[pos:pos + n], w)),
+                    jnp.asarray([pos], jnp.int32),
+                    jnp.asarray([n], jnp.int32), sub)
             pos += n
         return caches, tok, probs
 
@@ -914,8 +961,11 @@ class DecodePredictor:
         if fn is None:
             fn = jax.jit(self._prefill_impl)
             self._prefill_fns[(b, p)] = fn
-        return fn(self._env, tokens, lens,
-                  key if key is not None else self._zero_key)
+        args = (self._env, tokens, lens,
+                key if key is not None else self._zero_key)
+        self._roofline_register("prefill", fn, args)
+        with _obs.program_span("prefill"):
+            return fn(*args)
 
     def step(self, state, key=None):
         """One decode step: append ``state.tok``'s K/V, attend, sample.
@@ -928,8 +978,11 @@ class DecodePredictor:
             out = self.paged_step(state, self._paged_lens, key)
             self._paged_lens += 1
             return out
-        return self._decode_fn(self._env, state,
-                               key if key is not None else self._zero_key)
+        args = (self._env, state,
+                key if key is not None else self._zero_key)
+        self._roofline_register("decode_step", self._decode_fn, args)
+        with _obs.program_span("decode_step"):
+            return self._decode_fn(*args)
 
     def verify_step(self, state, draft_toks, draft_probs=None, key=None):
         """One speculative macro-step: verify k drafted tokens in ONE
@@ -959,8 +1012,11 @@ class DecodePredictor:
         draft_toks = jnp.asarray(draft_toks, jnp.int32)
         self._verify_shapes.add((draft_toks.shape[0], draft_toks.shape[1],
                                  draft_probs is not None))
-        return self._verify_fn(self._env, state, draft_toks, draft_probs,
-                               key if key is not None else self._zero_key)
+        args = (self._env, state, draft_toks, draft_probs,
+                key if key is not None else self._zero_key)
+        self._roofline_register("verify_step", self._verify_fn, args)
+        with _obs.program_span("verify_step"):
+            return self._verify_fn(*args)
 
     def generate_speculative(self, tokens, prompt_len=None,
                              max_new_tokens=16, seed=0, eos_id=None,
@@ -1530,7 +1586,7 @@ class DecodeServer:
 
     def __init__(self, predictor, max_prefill, slots=None, eos_id=None,
                  max_new_tokens=None, seed=0, spec_k=None, proposer=None,
-                 draft=None):
+                 draft=None, metrics_port=None):
         from . import config as _config
 
         self._pred = predictor
@@ -1576,12 +1632,47 @@ class DecodeServer:
         self.tokens_out = 0     # tokens delivered to finished requests
         self.proposed = 0       # drafted tokens offered to verify
         self.accepted = 0       # drafted tokens accepted
+        # registry mirrors of the loop counters (scrapeable over
+        # /metrics; the python ints above stay the bench's source)
+        self._m_steps = _obs.registry.counter(
+            "mx_serve_steps", "device steps executed by the serving loop")
+        self._m_spec = _obs.registry.counter(
+            "mx_serve_spec_steps", "speculative verify steps")
+        self._m_tokens = _obs.registry.counter(
+            "mx_serve_tokens", "tokens delivered to finished requests")
+        self._m_proposed = _obs.registry.counter(
+            "mx_spec_proposed", "drafted tokens offered to verify")
+        self._m_accepted = _obs.registry.counter(
+            "mx_spec_accepted", "drafted tokens accepted by the target")
+        # Prometheus-text exporter (heritage: kvstore_server.py's server
+        # process contract): MXNET_METRICS_PORT / metrics_port= arms the
+        # process-wide HTTP sidecar serving the registry + timeline —
+        # shared per port, so sequential/concurrent servers coexist
+        if metrics_port is None:
+            metrics_port = int(_config.get("MXNET_METRICS_PORT"))
+        self.metrics_server = _obs.serve_metrics(metrics_port) \
+            if metrics_port else None
 
     @property
     def accept_rate(self):
         """Fraction of drafted tokens the target accepted (the k-tuning
         signal: tokens/step = 1 + accept_rate * k on average)."""
         return self.accepted / max(self.proposed, 1)
+
+    def _note_step(self, spec=False):
+        """One device step executed (python counters + registry mirror)."""
+        self.steps += 1
+        self._m_steps.inc()
+        if spec:
+            self.spec_steps += 1
+            self._m_spec.inc()
+
+    def _note_accept(self, proposed, accepted):
+        """One slot's speculative window accounted."""
+        self.proposed += proposed
+        self.accepted += accepted
+        self._m_proposed.inc(proposed)
+        self._m_accepted.inc(accepted)
 
     def submit(self, tokens, max_new_tokens=None):
         """Queue a prompt (1-D int sequence); returns the request id."""
@@ -1617,6 +1708,8 @@ class DecodeServer:
         _prof.record_request(
             rec.get("admit", rec["submit"]) - rec["submit"],
             first - rec["submit"], ntokens, now - first)
+        _obs.instant("retire", cat="serve",
+                     args={"rid": rid, "tokens": int(ntokens)})
         self._done_rids.append(rid)
         while len(self._done_rids) > self._REQ_CAP:
             self._req.pop(self._done_rids.popleft(), None)
@@ -1645,6 +1738,7 @@ class DecodeServer:
                     or len(toks) >= max_new:
                 results[rid] = np.asarray(toks, np.int32)
                 self.tokens_out += len(toks)
+                self._m_tokens.inc(len(toks))
                 self._finish(rid, len(toks))
                 del active[slot]
                 if on_retire is not None:
@@ -1677,6 +1771,7 @@ class DecodeServer:
                 for r in done if r["tokens"] > 1)
             if rates:
                 out["decode_tokens_per_sec_p50"] = _percentile(rates, 0.50)
+                out["decode_tokens_per_sec_p95"] = _percentile(rates, 0.95)
         if getattr(self._pred, "_paged", False) \
                 and self._pred._manager is not None:
             out.update(self._pred._manager.stats())
@@ -1736,6 +1831,8 @@ class DecodeServer:
                 rec["admit"] = rec["first"] = time.time()
                 slot = next(s for s in range(self._slots)
                             if s not in active)
+                _obs.instant("admit", cat="serve",
+                             args={"rid": rid, "slot": slot})
                 if state is None:
                     state = _empty_batch_state(one, self._slots)
                 first = int(np.asarray(one.tok)[0, 0])
@@ -1763,18 +1860,16 @@ class DecodeServer:
                     state, draft_toks, draft_probs, sub)
                 out_h = np.asarray(out)
                 counts_h = np.asarray(counts).astype(np.int64)
-                self.steps += 1
-                self.spec_steps += 1
+                self._note_step(spec=True)
                 for slot, rec in active.items():
                     emitted = out_h[slot, :counts_h[slot]]
-                    self.proposed += k
-                    self.accepted += int(counts_h[slot]) - 1
+                    self._note_accept(k, int(counts_h[slot]) - 1)
                     deliver(rec, emitted)
                     histories[slot].extend(int(t) for t in emitted)
                 slot_lens += counts_h
             else:
                 state, _ = self._pred.step(state, sub)
-                self.steps += 1
+                self._note_step()
                 toks = np.asarray(state.tok)[:, 0]
                 for slot, rec in active.items():
                     deliver(rec, toks[slot:slot + 1])
@@ -1842,6 +1937,9 @@ class DecodeServer:
             slot = next(s for s in range(slots) if s not in active)
             mgr.map_slot(slot, pages, reserve_n)
             self._req[rid]["admit"] = time.time()
+            _obs.instant("admit", cat="serve",
+                         args={"rid": rid, "slot": slot,
+                               "prefix_matched": int(matched)})
             return {"slot": slot, "rid": rid,
                     "prompt": np.asarray(prompt).reshape(-1)
                     .astype(np.int64), "cap": cap, "pos": int(matched)}
@@ -1870,13 +1968,18 @@ class DecodeServer:
                 caches = pred._run_forks(state.caches, copies) \
                     if copies else state.caches
                 key, sub = jax.random.split(key)
-                caches, probs, tok = pred._chunk_fn(
-                    pred._env, caches,
-                    jnp.asarray(mgr.tables[p["slot"]:p["slot"] + 1]),
-                    jnp.asarray(_pad_window(
-                        p["prompt"][p["pos"]:p["pos"] + n], self._chunk_w)),
-                    jnp.asarray([p["pos"]], jnp.int32),
-                    jnp.asarray([n], jnp.int32), sub)
+                _obs.instant("prefill_chunk", cat="serve",
+                             args={"slot": p["slot"], "pos": p["pos"],
+                                   "tokens": int(n)})
+                with _obs.program_span("prefill"):
+                    caches, probs, tok = pred._chunk_fn(
+                        pred._env, caches,
+                        jnp.asarray(mgr.tables[p["slot"]:p["slot"] + 1]),
+                        jnp.asarray(_pad_window(
+                            p["prompt"][p["pos"]:p["pos"] + n],
+                            self._chunk_w)),
+                        jnp.asarray([p["pos"]], jnp.int32),
+                        jnp.asarray([n], jnp.int32), sub)
                 state = DecodeState(caches, state.lens, state.tok)
                 p["pos"] += n
                 pred._chunk_widths.add(self._chunk_w)
@@ -1918,18 +2021,16 @@ class DecodeServer:
                     act_mask)
                 out_h = np.asarray(out)
                 counts_h = np.asarray(counts).astype(np.int64)
-                self.steps += 1
-                self.spec_steps += 1
+                self._note_step(spec=True)
                 for slot, rec in active.items():
                     emitted = out_h[slot, :counts_h[slot]]
-                    self.proposed += k
-                    self.accepted += int(counts_h[slot]) - 1
+                    self._note_accept(k, int(counts_h[slot]) - 1)
                     deliver(rec, emitted)
                     histories[slot].extend(int(t) for t in emitted)
                 slot_lens += counts_h
             else:
                 state, _ = pred.paged_step(state, slot_lens, sub, act_mask)
-                self.steps += 1
+                self._note_step()
                 toks = np.asarray(state.tok)[:, 0]
                 for slot, rec in active.items():
                     deliver(rec, toks[slot:slot + 1])

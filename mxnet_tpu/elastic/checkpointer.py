@@ -124,6 +124,10 @@ class Checkpointer:
                 "rng": _rnd._key(),
                 "metric_device": _metric_device_copy(module),
             }
+            from .. import obs as _obs
+
+            _obs.instant("ckpt_fence", cat="elastic",
+                         args={"step": int(meta.get("global_step", -1))})
             if self.async_write:
                 self._thread = threading.Thread(
                     target=self._write_guarded, args=(job,), daemon=True,
@@ -207,7 +211,14 @@ class Checkpointer:
         #    resumes from steps that got this far
         ckpt_mod.commit_step(path)
         self.writes += 1
-        _prof.record_ckpt_write((time.perf_counter() - t0) * 1e3)
+        ms = (time.perf_counter() - t0) * 1e3
+        _prof.record_ckpt_write(ms)
+        # the commit instant lands from the WRITER thread — the timeline
+        # is thread-aware, so the overlap with loop steps is visible
+        from .. import obs as _obs
+
+        _obs.instant("ckpt_commit", cat="elastic",
+                     args={"step": step, "ms": round(ms, 3)})
         self._prune()
 
     def _prune(self):

@@ -114,6 +114,11 @@ class ElasticController:
                 self._replay_epochs = int(meta["epoch"])
                 self.recoveries += 1
                 _prof.bump_recovery()
+                from .. import obs as _obs
+
+                _obs.instant("elastic_resume", cat="elastic",
+                             args={"step": self.global_step,
+                                   "epoch": int(meta["epoch"])})
                 log.info("elastic resume: step %d (epoch %d, %d batches "
                          "into it) from %s", self.global_step,
                          meta["epoch"], meta["nbatch_done"], ck.directory)
@@ -206,6 +211,12 @@ class ElasticController:
         log.warning("elastic %s: dead=%s -> re-forming mesh on %d/%d "
                     "devices (data axis %d)", event.kind, event.dead,
                     len(devs), len(self._full_contexts), cfg.data)
+        from .. import obs as _obs
+
+        _obs.instant("elastic_" + event.kind, cat="elastic",
+                     args={"dead": list(event.dead),
+                           "devices": len(devs),
+                           "data_axis": int(cfg.data)})
         module.reconfigure(devs, cfg if len(devs) > 1 else None)
         # the rebuilt fused step needs the metric re-armed
         module._bind_metric(eval_metric)

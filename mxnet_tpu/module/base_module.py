@@ -235,6 +235,12 @@ class BaseModule:
             import jax
 
             stack.enter_context(jax.transfer_guard_device_to_host(guard))
+        # one timeline span per epoch (always-on, bounded ring): the
+        # host_wait/input_wait/ckpt_* loop spans nest under it
+        from .. import obs as _obs
+
+        stack.enter_context(_obs.span("fit_epoch", cat="loop",
+                                      args={"epoch": int(epoch)}))
         with stack:
             while True:
                 t0 = time.perf_counter()

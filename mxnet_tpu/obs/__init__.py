@@ -1,0 +1,161 @@
+"""mxnet_tpu.obs — the unified telemetry subsystem.
+
+Three layers (docs/observability.md), shared process-wide singletons:
+
+* :data:`registry` — the typed/labeled metrics registry
+  (:mod:`~mxnet_tpu.obs.metrics`): counters, gauges, histograms behind
+  one lock, with JSON-lines and Prometheus exporters;
+* :data:`timeline` — the always-on trace timeline
+  (:mod:`~mxnet_tpu.obs.trace`): a bounded ring buffer of thread-aware
+  spans and instant events, exported as Chrome-trace JSON (Perfetto);
+* :data:`programs` — per-program roofline accounting
+  (:mod:`~mxnet_tpu.obs.roofline`): measured dispatch wall per compiled
+  program joined against static FLOPs/bytes into the MFU table.
+
+``profiler`` (the historical module) is a thin compatibility facade over
+these; new code records here directly.  Instrumentation is HOST-side
+only: nothing in this package runs inside a traced program, so compiled
+HLO is byte-identical with telemetry on or off (``MXNET_TELEMETRY``),
+and the zero-overhead tripwire in ``tests/test_obs.py`` plus the
+analysis ``host-sync`` pass keep it that way.
+"""
+from __future__ import annotations
+
+import time
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      PeriodicExporter, percentile)
+from .prom import MetricsServer
+from .roofline import (PEAK_FLOPS, ProgramAccounting, auto_peak,
+                       peak_flops_for, render_mfu_table)
+from .trace import TraceTimeline
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsServer",
+    "PEAK_FLOPS", "PeriodicExporter", "ProgramAccounting", "TraceTimeline",
+    "auto_peak", "enabled", "mfu_table", "peak_flops_for", "percentile",
+    "program_span", "programs", "registry", "render_mfu_table",
+    "serve_metrics", "span", "timeline",
+]
+
+from .. import config as _config
+
+# ---------------------------------------------------------------------------
+# process-wide singletons
+# ---------------------------------------------------------------------------
+registry = MetricsRegistry()
+timeline = TraceTimeline(capacity=max(int(_config.get("MXNET_TRACE_BUFFER")),
+                                      1))
+programs = ProgramAccounting()
+
+
+def enabled():
+    """Whether telemetry recording is armed (``MXNET_TELEMETRY``).
+    Counters predating the subsystem (``profiler.step_stats``'s loop
+    accounting) stay on regardless; this gates the timeline spans /
+    instant events and the per-program dispatch timing."""
+    return bool(_config.get("MXNET_TELEMETRY"))
+
+
+def mfu_table(peak_flops=None):
+    """The per-program MFU/roofline table (see
+    :meth:`~mxnet_tpu.obs.roofline.ProgramAccounting.table`); the peak
+    defaults to ``MXNET_PEAK_FLOPS`` or the device spec sheet."""
+    return programs.table(auto_peak() if peak_flops is None else peak_flops)
+
+
+# ---------------------------------------------------------------------------
+# no-op-when-disabled recording helpers (the instrumentation surface the
+# rest of the framework calls — one isinstance-free fast path each)
+# ---------------------------------------------------------------------------
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _ProgramSpan:
+    """Times one compiled-program dispatch: feeds the roofline
+    accounting AND drops a span on the timeline (cat="program")."""
+
+    __slots__ = ("_name", "_t0", "_w0")
+
+    def __init__(self, name):
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._w0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        programs.note(self._name, dt)
+        timeline.add_span(self._name, self._w0, dt, cat="program")
+        return False
+
+
+def program_span(name):
+    """Context manager timing one dispatch of program ``name`` (no-op
+    when telemetry is off)."""
+    return _ProgramSpan(name) if enabled() else _NULL
+
+
+def span(name, cat="host", args=None):
+    """Context manager recording one timeline span (no-op when off)."""
+    return timeline.span(name, cat=cat, args=args) if enabled() else _NULL
+
+
+def instant(name, cat="event", args=None):
+    """Record one timeline instant event (no-op when off)."""
+    if enabled():
+        timeline.instant(name, cat=cat, args=args)
+
+
+# ---------------------------------------------------------------------------
+# process-wide HTTP exporters — the registry/timeline are process-global,
+# so one server per (host, port) is the correct cardinality; a second
+# DecodeServer configured for the same port must REUSE the first server,
+# not crash on EADDRINUSE
+# ---------------------------------------------------------------------------
+import threading as _threading
+
+_servers = {}
+_servers_lock = _threading.Lock()
+
+
+def serve_metrics(port, host="127.0.0.1"):
+    """Get-or-create the process-wide :class:`MetricsServer` bound to
+    ``(host, port)``, serving the global registry and timeline."""
+    key = (host, int(port))
+    with _servers_lock:
+        srv = _servers.get(key)
+        if srv is None or srv._httpd is None:
+            srv = MetricsServer(port=int(port), host=host).start()
+            _servers[key] = srv
+        return srv
+
+
+# ---------------------------------------------------------------------------
+# env-armed periodic JSON-lines export
+# ---------------------------------------------------------------------------
+_exporter = None
+
+
+def _maybe_start_exporter():
+    global _exporter
+    path = _config.get("MXNET_METRICS_EXPORT")
+    period = float(_config.get("MXNET_METRICS_EXPORT_PERIOD"))
+    if _exporter is None and path and period > 0:
+        _exporter = PeriodicExporter(registry, path, period).start()
+    return _exporter
+
+
+_maybe_start_exporter()

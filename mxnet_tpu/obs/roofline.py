@@ -1,0 +1,206 @@
+"""Per-program roofline accounting — measured wall time vs static cost.
+
+The telemetry subsystem's third layer (docs/observability.md): the
+compiled-step dispatch wrappers (``CompiledTrainStep`` /
+``CompiledEvalStep`` / ``DecodePredictor``) report host-observed wall
+seconds per named program into one :class:`ProgramAccounting`, and each
+program registers a LAZY static-cost prober
+(:func:`mxnet_tpu.analysis.cost.program_cost`: dot FLOPs from the
+lowered StableHLO, traffic bytes from arg+output avals through the
+analysis width table).  :meth:`ProgramAccounting.table` joins the two
+into the per-program MFU / achieved-bytes/s table ``bench.py`` publishes
+in its JSON contract and ``tools/mxstat.py`` renders — the ROADMAP's
+"track the roofline gap per kernel, not in aggregate".
+
+Wall-time semantics: a program's ``wall_s`` is the host time spent
+INSIDE its dispatch calls.  jax dispatch is asynchronous, so on a
+backend with deep async queues this under-measures device time for a
+single call — but ``fit()`` bounds in-flight steps on a fence
+(``MXNET_MAX_STEPS_IN_FLIGHT``) and the decode loop reads each step's
+tokens, so in the steady state the host is throttled by the device and
+the accumulated dispatch wall converges to device wall.  The
+interpretation caveats (and the ``host_wait`` cross-check) live in
+docs/observability.md.  The probers trace+lower only (never compile,
+never execute) and run at TABLE time, off every hot path.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["ProgramAccounting", "PEAK_FLOPS", "peak_flops_for",
+           "auto_peak", "render_mfu_table"]
+
+# peak bf16 FLOP/s per chip by TPU generation (public spec sheets) —
+# moved here from bench.py so the bench and the MFU table share one map
+PEAK_FLOPS = {
+    "TPU v2": 45e12 / 2,      # per-chip: 2 cores, 22.5T each
+    "TPU v3": 123e12 / 2,
+    "TPU v4": 275e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+    "TPU7x": 2307e12,
+}
+
+
+def peak_flops_for(device):
+    """``(peak_flops_or_None, device_kind)`` for a jax device."""
+    kind = getattr(device, "device_kind", "")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak, kind
+    return None, kind
+
+
+def auto_peak():
+    """The MFU denominator: ``MXNET_PEAK_FLOPS`` when set, else the spec
+    peak of the first jax device, else ``None`` (CPU harness — the table
+    still carries flops/bytes/wall, mfu reads null)."""
+    from .. import config as _config
+
+    override = float(_config.get("MXNET_PEAK_FLOPS"))
+    if override > 0:
+        return override
+    try:
+        import jax
+
+        peak, _ = peak_flops_for(jax.devices()[0])
+        return peak
+    except Exception:
+        return None
+
+
+class ProgramAccounting:
+    """Measured wall seconds + lazy static costs, per program name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._timing = {}   # name -> [calls, wall_s]
+        self._probers = {}  # name -> () -> {"flops", "bytes"} | None
+        self._static = {}   # name -> resolved {"flops", "bytes"} | error row
+
+    # ------------------------------------------------------------------
+    def note(self, name, seconds):
+        """One dispatch of ``name`` took ``seconds`` of host wall."""
+        with self._lock:
+            t = self._timing.get(name)
+            if t is None:
+                t = self._timing[name] = [0, 0.0]
+            t[0] += 1
+            t[1] += seconds
+
+    def register_static(self, name, prober):
+        """Attach a lazy static-cost prober (idempotent; the newest
+        registration wins so a rebuilt program refreshes its cost).
+        Producers register weakly-bound probers — a prober may return
+        None (owner gone, or program not yet runnable) and the row then
+        simply carries no static columns."""
+        with self._lock:
+            self._probers[name] = prober
+            self._static.pop(name, None)
+
+    def set_static(self, name, flops, bytes):
+        """Directly record a program's static cost (mxstat --smoke, or a
+        caller that already holds an artifact)."""
+        with self._lock:
+            self._static[name] = {"flops": int(flops), "bytes": int(bytes)}
+            self._probers.pop(name, None)
+
+    def reset(self, clear_static=False):
+        """Zero the timings (a bench's measurement window starts here);
+        static registrations survive unless ``clear_static``."""
+        with self._lock:
+            self._timing.clear()
+            if clear_static:
+                self._probers.clear()
+                self._static.clear()
+
+    # ------------------------------------------------------------------
+    def _resolve_static(self, name):
+        """Run (once) and cache ``name``'s prober.  A prober returning
+        None (program not yet runnable) is retried next time; a raising
+        prober is cached as an error so a broken lowering cannot re-pay
+        its cost on every table."""
+        with self._lock:
+            hit = self._static.get(name)
+            prober = self._probers.get(name)
+        if hit is not None:
+            return hit
+        if prober is None:
+            return None
+        try:
+            cost = prober()
+        except Exception as exc:  # surfaced in the row, not raised
+            cost = {"flops": None, "bytes": None, "error": str(exc)[:200]}
+        if cost is None:
+            return None
+        with self._lock:
+            self._static[name] = cost
+            # resolved: drop the prober so it cannot pin its program's
+            # owner (a model's whole parameter store) for process life
+            self._probers.pop(name, None)
+        return cost
+
+    def table(self, peak_flops=None):
+        """The joined per-program rows, sorted by wall share (largest
+        first): ``{"program", "calls", "wall_s", "flops", "bytes",
+        "achieved_tflops", "achieved_gbps", "mfu"}`` — flops/bytes are
+        PER CALL; mfu is achieved FLOP/s over ``peak_flops`` (null
+        without a peak)."""
+        with self._lock:
+            names = set(self._timing) | set(self._probers) \
+                | set(self._static)
+            timing = {n: tuple(v) for n, v in self._timing.items()}
+        rows = []
+        for name in names:
+            calls, wall = timing.get(name, (0, 0.0))
+            cost = self._resolve_static(name) or {}
+            flops = cost.get("flops")
+            nbytes = cost.get("bytes")
+            row = {"program": name, "calls": calls,
+                   "wall_s": round(wall, 6),
+                   "flops": flops, "bytes": nbytes,
+                   "achieved_tflops": None, "achieved_gbps": None,
+                   "mfu": None}
+            if "error" in cost:
+                row["error"] = cost["error"]
+            if wall > 0 and calls > 0:
+                if flops:
+                    rate = flops * calls / wall
+                    row["achieved_tflops"] = round(rate / 1e12, 6)
+                    if peak_flops:
+                        row["mfu"] = round(rate / peak_flops, 6)
+                if nbytes:
+                    row["achieved_gbps"] = round(nbytes * calls / wall / 1e9,
+                                                 6)
+            rows.append(row)
+        rows.sort(key=lambda r: -r["wall_s"])
+        return rows
+
+
+def _fmt(v, unit=""):
+    if v is None:
+        return "-"
+    if isinstance(v, float) and unit == "":
+        return "%.4g" % v
+    return "%s%s" % (v, unit)
+
+
+def render_mfu_table(rows):
+    """Fixed-width text rendering of :meth:`ProgramAccounting.table`
+    rows (the ``tools/mxstat.py`` output)."""
+    cols = ("program", "calls", "wall_s", "flops", "bytes",
+            "achieved_tflops", "achieved_gbps", "mfu")
+    table = [[str(c) for c in cols]]
+    for r in rows:
+        table.append([_fmt(r.get(c)) for c in cols])
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)

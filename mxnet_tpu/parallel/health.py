@@ -282,6 +282,12 @@ class FailureMonitor:
                               timeout=self.timeout, now=now,
                               grace=self.grace))
         dead.discard(self.my_rank)
+        from .. import obs as _obs
+
+        _obs.registry.gauge(
+            "mx_dead_workers",
+            "ranks the failure monitor currently reads as dead").set(
+                len(dead))
         if self.current_dead is None:
             # the first poll is NOT a free pass: a rank that died between
             # launch and the first fence (e.g. while step 0 compiled) must
@@ -291,9 +297,22 @@ class FailureMonitor:
             # grace window, not by baseline adoption.
             self.current_dead = dead
             if dead:
-                return ReconfigEvent(dead, dead, set())
+                event = ReconfigEvent(dead, dead, set())
+                _obs.instant("heartbeat_" + event.kind, cat="elastic",
+                             args={"dead": event.dead,
+                                   "newly_dead": event.newly_dead,
+                                   "returned": event.returned})
+                return event
             return None
         if dead == self.current_dead:
             return None
         prev, self.current_dead = self.current_dead, dead
-        return ReconfigEvent(dead, dead - prev, prev - dead)
+        event = ReconfigEvent(dead, dead - prev, prev - dead)
+        # the liveness transition itself (the controller marks the mesh
+        # re-form separately — a transition can also be observed by
+        # monitors outside a training loop)
+        _obs.instant("heartbeat_" + event.kind, cat="elastic",
+                     args={"dead": event.dead,
+                           "newly_dead": event.newly_dead,
+                           "returned": event.returned})
+        return event

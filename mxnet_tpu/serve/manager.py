@@ -192,6 +192,14 @@ class PagedKVManager:
             row[idx] = fresh
             self._own[slot, idx] = True
             self.allocator.forks += 1
+        if copies:
+            from .. import obs as _obs
+
+            _obs.registry.counter(
+                "mx_cow_forks",
+                "copy-on-write page forks planned").inc(len(copies))
+            _obs.instant("cow_fork", cat="serve",
+                         args={"slot": int(slot), "copies": len(copies)})
         return copies
 
     def publish(self, slot, prompt, prompt_len):

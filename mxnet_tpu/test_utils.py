@@ -450,3 +450,38 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
         oracle = ground_truth or collect_all(exes[oracle_idx])
         compare(collect_all, oracle)
     return oracle
+
+
+# -- telemetry helpers ------------------------------------------------------
+
+
+def assert_chrome_trace(payload, required_names=()):
+    """Validate a Chrome-trace export (``obs.timeline.export`` /
+    ``profiler.dump_profile`` payload): the ``traceEvents`` schema every
+    viewer (chrome://tracing, Perfetto) relies on, plus presence of
+    ``required_names`` — so tests can pin that a real fit / serve /
+    elastic run actually landed its spans and instant events."""
+    assert isinstance(payload, dict) and "traceEvents" in payload, payload
+    events = payload["traceEvents"]
+    assert isinstance(events, list) and events
+    for e in events:
+        # real jax.profiler captures carry float-microsecond timestamps
+        # and phases beyond our own (flow s/t/f, B/E pairs, counters) —
+        # require only what every viewer requires, and the full contract
+        # on the phases this framework emits itself
+        assert isinstance(e, dict)
+        ph = e.get("ph")
+        assert isinstance(ph, str) and ph, e
+        assert isinstance(e.get("ts", 0), (int, float)), e
+        if ph == "X":
+            assert isinstance(e.get("name"), str) and "pid" in e \
+                and "tid" in e, e
+            assert e.get("dur", 0) >= 0, e
+        if ph == "i":
+            assert isinstance(e.get("name"), str), e
+            assert e.get("s") in ("t", "p", "g"), e
+    names = {e.get("name") for e in events}
+    missing = set(required_names) - names
+    assert not missing, ("missing trace events %s (have %d events)"
+                        % (sorted(missing), len(events)))
+    return names

@@ -24,12 +24,30 @@ from __future__ import annotations
 
 import logging
 import pickle
+import time
 
 import numpy as np
 
+from . import obs as _obs
 from .base import MXNetError
 
 __all__ = ["CompiledTrainStep", "CompiledEvalStep"]
+
+
+def _weak_prober(step):
+    """A roofline static-cost prober that does NOT pin the step object
+    (and transitively its executor group + master weights) in the
+    process-global accounting: once the step is collected, the prober
+    resolves to None and the program's row simply keeps no statics."""
+    import weakref
+
+    ref = weakref.ref(step)
+
+    def prober():
+        live = ref()
+        return live.roofline_static() if live is not None else None
+
+    return prober
 
 
 class CompiledEvalStep:
@@ -104,6 +122,7 @@ class CompiledEvalStep:
         self._fn = jax.jit(step, donate_argnums=(2,))
         self._last_args = None   # aval snapshot for artifact probes
         self._snap_traces = -1   # trace_count the snapshot was taken at
+        self._static_registered = False  # roofline prober armed once
 
     def _place(self, arr, name):
         import jax
@@ -119,9 +138,31 @@ class CompiledEvalStep:
             return jax.device_put(v, group._input_sharding(name))
         return jax.device_put(v, group.contexts[0].jax_device)
 
+    # telemetry: the roofline row this program's dispatch wall accrues to
+    telemetry_name = "eval_step"
+
     def run(self, data_batch):
         """Accumulate one batch on device.  No host transfer happens here;
-        the metric's accumulator state is donated through the program."""
+        the metric's accumulator state is donated through the program.
+        Dispatch wall time feeds the per-program roofline table
+        (``obs.programs``) — host-side only, the program is untouched."""
+        if not _obs.enabled():
+            return self._run_impl(data_batch)
+        if not self._static_registered:
+            self._static_registered = True
+            _obs.programs.register_static(self.telemetry_name,
+                                          _weak_prober(self))
+        t0 = time.perf_counter()
+        w0 = time.time()
+        try:
+            return self._run_impl(data_batch)
+        finally:
+            dt = time.perf_counter() - t0
+            _obs.programs.note(self.telemetry_name, dt)
+            _obs.timeline.add_span(self.telemetry_name, w0, dt,
+                                   cat="program")
+
+    def _run_impl(self, data_batch):
         from . import random as _rnd
 
         exe = self._exec
@@ -203,6 +244,21 @@ class CompiledEvalStep:
                 donated_leaves=donated, trace_count=count,
                 expected_traces=1,
                 metric=type(self._acc.metric).__name__)
+        finally:
+            self._probing = False
+
+    def roofline_static(self):
+        """Static FLOPs + traffic bytes of the eval program at the
+        last-run shapes (None before the first ``run``) — the lazy
+        roofline join, trace+lower only, probe-flagged so it never
+        counts as a retrace."""
+        from .analysis.cost import program_cost
+
+        if self._last_args is None:
+            return None
+        self._probing = True
+        try:
+            return program_cost(self._fn, self._last_args)
         finally:
             self._probing = False
 
@@ -291,6 +347,7 @@ class CompiledTrainStep:
         self._fns[id(exec_group.exec_)] = (self._fn, exec_group.exec_)
         self.num_steps = 0
         self._hyper_cache = None
+        self._static_registered = False  # roofline prober armed once
         # lifecycle state is a property of the shared store, not of any one
         # module (several bucket modules may view this step)
         self.step_stale = False   # executor buffers newer than the store
@@ -444,13 +501,37 @@ class CompiledTrainStep:
         self.programs_built += 1
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
+    # telemetry: the roofline row this program's dispatch wall accrues to
+    # (one shared store = one row, however many bucket executors)
+    telemetry_name = "train_step"
+
     # ------------------------------------------------------------------
     def run(self, data_batch, group=None):
         """Execute one full training step; returns output jnp arrays.
 
         ``group`` selects the (bucket) executor whose graph to run; the
-        master weights/slots are this store's regardless.
+        master weights/slots are this store's regardless.  Dispatch wall
+        time feeds the per-program roofline table (``obs.programs``) —
+        host-side timing only, the compiled program is byte-identical
+        with telemetry on or off (tests/test_obs.py pins it).
         """
+        if not _obs.enabled():
+            return self._run_impl(data_batch, group)
+        if not self._static_registered:
+            self._static_registered = True
+            _obs.programs.register_static(self.telemetry_name,
+                                          _weak_prober(self))
+        t0 = time.perf_counter()
+        w0 = time.time()
+        try:
+            return self._run_impl(data_batch, group)
+        finally:
+            dt = time.perf_counter() - t0
+            _obs.programs.note(self.telemetry_name, dt)
+            _obs.timeline.add_span(self.telemetry_name, w0, dt,
+                                   cat="program")
+
+    def _run_impl(self, data_batch, group=None):
         from . import random as _rnd
 
         group = group if group is not None else self._group
@@ -621,6 +702,24 @@ class CompiledTrainStep:
                 else None,
                 mesh_shape=mesh_shape, trace_count=count,
                 expected_traces=built, num_steps=self.num_steps)
+        finally:
+            self._probing = False
+
+    def roofline_static(self, group=None):
+        """Static FLOPs + traffic bytes of the fused step program at the
+        live shapes (None before the first ``run``) — the lazy roofline
+        join for ``obs.programs``.  Trace+lower only (no compile, no
+        execution), probe-flagged so it never counts as a retrace."""
+        from .analysis.cost import program_cost
+
+        group = group if group is not None else self._group
+        args = self._abstract_args(group)
+        if args is None:
+            return None
+        fn = self._entry_for(group)
+        self._probing = True
+        try:
+            return program_cost(fn, args)
         finally:
             self._probing = False
 

@@ -3,7 +3,13 @@
 `bench.py --smoke` drives a small MLP fit through the FULL async training
 loop (device-side metrics + device prefetch + bounded in-flight dispatch)
 and must emit the loop-accounting fields `input_stall_fraction` and
-`host_syncs_per_step` alongside the metric contract.
+`host_syncs_per_step` alongside the metric contract — plus the
+per-program `mfu_table` roofline rows (mxnet_tpu.obs): flops, bytes,
+wall_s and mfu for every canonical program the smoke drives.
+
+`tools/mxstat.py --smoke` self-checks the telemetry machinery (registry
+concurrency, numpy-exact histogram percentiles, exporters, the
+ring-bounded chrome-trace timeline, the MFU-table join) without jax.
 
 Tier-1 smoke run of the long-context benchmark.
 
@@ -45,11 +51,14 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_bench_smoke_async_loop_contract():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
-    # scrub inherited bench/loop knobs so the smoke measures the defaults
+    # scrub inherited bench/loop/telemetry knobs so the smoke measures
+    # the defaults
     for key in [k for k in env if k.startswith("BENCH_")
+                or k.startswith("MXNET_METRICS_")
                 or k in ("MXNET_DEVICE_METRICS", "MXNET_DEVICE_PREFETCH",
                          "MXNET_MAX_STEPS_IN_FLIGHT",
-                         "MXNET_METRIC_SYNC_PERIOD")]:
+                         "MXNET_METRIC_SYNC_PERIOD", "MXNET_TELEMETRY",
+                         "MXNET_TRACE_BUFFER", "MXNET_PEAK_FLOPS")]:
         env.pop(key)
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py"), "--smoke"],
@@ -79,6 +88,24 @@ def test_bench_smoke_async_loop_contract():
     assert head["recoveries"] == 0, head
     assert 0.0 <= head["checkpoint_stall_fraction"] <= 1.0, head
     assert head["last_ckpt_ms"] > 0.0, head
+    # ... plus the per-program MFU/roofline table (mxnet_tpu.obs): every
+    # canonical program the smoke drives — the fused train step, the
+    # device-metric eval step, the KV-cache prefill and the donated
+    # decode step — gets a row joining measured dispatch wall against
+    # static FLOPs and traffic bytes.  mfu itself is null on the CPU
+    # harness (no spec-sheet peak) but the field must be present; on a
+    # TPU it is a number in (0, 1].
+    rows = {r["program"]: r for r in head["mfu_table"]}
+    for prog in ("train_step", "eval_step", "prefill", "decode_step"):
+        assert prog in rows, sorted(rows)
+        row = rows[prog]
+        for key in ("flops", "bytes", "wall_s", "mfu"):
+            assert key in row, row
+        assert row["calls"] > 0 and row["wall_s"] > 0, row
+        assert row["flops"] > 0 and row["bytes"] > 0, row
+        assert row["mfu"] is None or 0 < row["mfu"] <= 1, row
+    # the fit dominates: train_step saw every step the loop dispatched
+    assert rows["train_step"]["calls"] >= 50, rows["train_step"]
 
 
 def test_bench_long_context_smoke_contract():
@@ -201,6 +228,39 @@ def test_bench_decode_smoke_contract():
     paged_row = next(r for r in rows if r.get("phase") == "serve_paged")
     assert paged_row["pool_bytes"] < paged_row["dense_ring_bytes"]
     assert paged_row["spec_steps"] > 0
+
+
+def test_mxstat_smoke_contract():
+    """`tools/mxstat.py --smoke` must self-check the telemetry machinery
+    (concurrent counter sums, numpy-exact histogram percentiles, the
+    JSON-lines/Prometheus exporters, the ring-bounded timeline's
+    chrome-trace schema, and the MFU-table join) and emit one
+    bench-contract JSON line with zero failed checks.  The LIVE
+    pipeline — real compiled programs feeding the same table — is pinned
+    by test_bench_smoke_async_loop_contract's mfu_table assertions; this
+    keeps the CLI and exporters honest at near-zero cost (no jax)."""
+    env = dict(os.environ)
+    for key in [k for k in env if k.startswith("MXNET_METRICS_")
+                or k in ("MXNET_TELEMETRY", "MXNET_TRACE_BUFFER",
+                         "MXNET_PEAK_FLOPS")]:
+        env.pop(key)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxstat.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=120, cwd=ROOT, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-3000:])
+
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    head = json.loads(lines[0])
+    assert head["metric"] == "mxstat_smoke_checks"
+    assert head["unit"] == "checks"
+    assert head["value"] >= 5 and head["vs_baseline"] == 1.0, head
+    assert head["failed"] == [], head
+    assert head["programs"] == 2, head
+    # stderr carries the rendered table: both synthetic programs present
+    assert "train_step" in proc.stderr and "decode_step" in proc.stderr
+    assert "mfu" in proc.stderr
 
 
 def test_mxlint_smoke_contract():

@@ -267,6 +267,20 @@ def test_shrink_and_regrow_data_axis(tmp_path):
     # across DIFFERENT mesh widths (a 4-device fence restored onto 8)
     assert checkpoint.latest_step(ck) is not None
 
+    # the telemetry acceptance half: exporting the always-on timeline
+    # right after this run yields VALID chrome-trace JSON whose events
+    # cover the fit (epoch spans, fused-step program spans) AND the
+    # elastic protocol — the heartbeat transitions, both mesh re-forms,
+    # the fence checkpoints and their writer-thread commits
+    from mxnet_tpu import obs
+    from mxnet_tpu.test_utils import assert_chrome_trace
+
+    assert_chrome_trace(
+        obs.timeline.export(),
+        required_names=("fit_epoch", "train_step", "heartbeat_shrink",
+                        "heartbeat_regrow", "elastic_shrink",
+                        "elastic_regrow", "ckpt_fence", "ckpt_commit"))
+
 
 # ---------------------------------------------------------------------------
 # async overlap + stall accounting

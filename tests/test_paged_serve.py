@@ -191,6 +191,18 @@ def test_paged_server_shared_prefix_matches_dense():
     assert pstats["requests"]["count"] >= len(prompts)
     assert pstats["requests"]["ttft_p95_s"] >= 0
 
+    # the telemetry acceptance half for serving: the always-on timeline
+    # exported right after this drive is valid chrome-trace JSON whose
+    # events cover the serving schedule — admissions, chunked-prefill
+    # windows, retirements and the per-dispatch program spans
+    from mxnet_tpu import obs
+    from mxnet_tpu.test_utils import assert_chrome_trace
+
+    assert_chrome_trace(
+        obs.timeline.export(),
+        required_names=("admit", "retire", "prefill_chunk", "prefill",
+                        "paged_decode_step"))
+
 
 def test_paged_server_speculative_matches_generate():
     """Speculative verify over page tables (quantized pools): the paged

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""mxstat — render and sanity-check the unified telemetry surfaces.
+
+The CLI half of ``mxnet_tpu.obs`` (docs/observability.md): the per-program
+MFU/roofline table the compiled-step dispatch wrappers accumulate
+(``bench.py`` publishes it as the ``mfu_table`` field of its JSON
+contract), the metrics-registry exporters (JSON-lines snapshot,
+Prometheus text) and the Chrome-trace timeline export.
+
+Usage:
+
+* ``tools/mxstat.py BENCH.json``      — render the ``mfu_table`` found in
+  a bench contract line (or any JSON object carrying one) as a text
+  table; also accepts a file of JSON lines (the last line with an
+  ``mfu_table`` wins, so ``bench.py --smoke > out.json`` pipes straight
+  in).
+* ``tools/mxstat.py --snapshot``      — print the current process-wide
+  registry snapshot (mostly useful from an interactive session).
+* ``tools/mxstat.py --smoke``         — tier-1 CI mode
+  (tests/test_bench_contract.py invokes it): drive the registry /
+  timeline / roofline machinery end to end WITHOUT jax — concurrent
+  counter increments, a histogram cross-checked against numpy, a
+  ring-bounded timeline exported and re-parsed as Chrome-trace JSON, a
+  JSON-lines registry round-trip, a Prometheus-text render, and an MFU
+  table built from synthetic timings + static costs — then emit ONE
+  bench-contract JSON line on stdout (nonzero exit on any check
+  failure).  The REAL pipeline (live compiled programs feeding the same
+  table) is covered by ``bench.py --smoke``'s ``mfu_table`` contract;
+  this smoke keeps the CLI and the exporters honest at near-zero cost.
+
+Exit status: nonzero when a smoke check fails or no table is found.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_rows(path):
+    """The last ``mfu_table`` found in a JSON file or JSON-lines file."""
+    rows = None
+    with open(path) as f:
+        text = f.read()
+    try:
+        payloads = [json.loads(text)]
+    except ValueError:
+        payloads = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                payloads.append(json.loads(line))
+            except ValueError:
+                continue
+    for obj in payloads:
+        if isinstance(obj, dict):
+            if isinstance(obj.get("mfu_table"), list):
+                rows = obj["mfu_table"]
+            elif obj.get("metric") and isinstance(obj.get("value"), list):
+                rows = obj["value"]
+    return rows
+
+
+def smoke():
+    """Synthetic end-to-end drive of the obs machinery (no jax)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu.obs.metrics import MetricsRegistry
+    from mxnet_tpu.obs.roofline import ProgramAccounting, render_mfu_table
+    from mxnet_tpu.obs.trace import TraceTimeline
+
+    checks = {}
+
+    # 1. concurrent counter increments sum exactly
+    reg = MetricsRegistry()
+    c = reg.counter("mx_smoke_ops", "smoke increments", labels=("who",))
+    nthreads, per = 8, 5000
+
+    def worker(i):
+        child = c.labels(who="t%d" % (i % 2))
+        for _ in range(per):
+            child.inc()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(row["value"]
+                for row in reg.snapshot()["mx_smoke_ops"]["series"])
+    checks["counter_sum"] = total == nthreads * per
+
+    # 2. histogram percentiles match numpy on random data
+    h = reg.histogram("mx_smoke_latency", "smoke latencies")
+    rng = np.random.RandomState(0)
+    vals = rng.exponential(0.05, 1000)
+    for v in vals:
+        h.observe(v)
+    checks["histogram_numpy"] = all(
+        abs(h.percentile(q) - float(np.percentile(vals, q * 100))) < 1e-12
+        for q in (0.5, 0.9, 0.95, 0.99))
+
+    # 3. exporter round-trips + prometheus text renders the same values
+    with tempfile.TemporaryDirectory(prefix="mxstat_smoke_") as tmp:
+        path = os.path.join(tmp, "metrics.jsonl")
+        reg.export_jsonl(path)
+        with open(path) as f:
+            back = json.loads(f.readlines()[-1])
+        checks["jsonl_roundtrip"] = (
+            back["metrics"]["mx_smoke_latency"]["series"][0]["value"]
+            ["count"] == len(vals) and back["metrics"] == reg.snapshot())
+        prom = reg.prometheus_text()
+        checks["prometheus_text"] = (
+            "mx_smoke_latency_count 1000" in prom
+            and "# TYPE mx_smoke_ops counter" in prom)
+
+        # 4. ring-bounded timeline -> valid chrome-trace JSON
+        tl = TraceTimeline(capacity=256)
+        for i in range(1000):
+            with tl.span("step", cat="loop", args={"i": i}):
+                tl.instant("tick", args={"i": i})
+        checks["ring_bound"] = len(tl) == 256 and tl.dropped == 2000 - 256
+        trace_path = os.path.join(tmp, "trace.json")
+        tl.export(trace_path)
+        with open(trace_path) as f:
+            payload = json.load(f)
+        evs = payload.get("traceEvents", [])
+        checks["chrome_schema"] = bool(evs) and all(
+            isinstance(e["name"], str) and e["ph"] in ("X", "i")
+            and isinstance(e["ts"], int) and "pid" in e and "tid" in e
+            and (e["ph"] != "X" or e["dur"] >= 0)
+            and (e["ph"] != "i" or e.get("s") in ("t", "p", "g"))
+            for e in evs)
+
+    # 5. the MFU table joins timings with static costs
+    acc = ProgramAccounting()
+    for _ in range(10):
+        acc.note("train_step", 0.01)
+    acc.note("decode_step", 0.002)
+    acc.set_static("train_step", flops=2.5e9, bytes=1.2e8)
+    acc.set_static("decode_step", flops=1e7, bytes=4e6)
+    rows = acc.table(peak_flops=197e12)
+    by_name = {r["program"]: r for r in rows}
+    checks["mfu_rows"] = all(
+        r["flops"] > 0 and r["bytes"] > 0 and r["wall_s"] > 0
+        and r["mfu"] is not None and 0 <= r["mfu"] <= 1
+        for r in rows) and set(by_name) == {"train_step", "decode_step"}
+    print(render_mfu_table(rows), file=sys.stderr)
+
+    import bench as _bench
+
+    failed = sorted(k for k, ok in checks.items() if not ok)
+    print(_bench.contract_line(
+        "mxstat_smoke_checks", len(checks), "checks",
+        1.0 if not failed else 0.0, failed=failed,
+        programs=len(rows)))
+    return 1 if failed else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxstat", description="render the per-program MFU/roofline "
+        "table and telemetry exports (see docs/observability.md)")
+    ap.add_argument("file", nargs="?", default=None,
+                    help="JSON (or JSON-lines) file carrying an mfu_table "
+                    "field, e.g. bench.py --smoke output")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 CI mode: drive the registry/timeline/"
+                    "roofline machinery synthetically and self-check")
+    ap.add_argument("--snapshot", action="store_true",
+                    help="print the process-wide metrics snapshot as JSON")
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+
+    if args.smoke:
+        return smoke()
+    if args.snapshot:
+        from mxnet_tpu import obs
+
+        print(json.dumps(obs.registry.snapshot(), indent=2))
+        return 0
+    if args.file is None:
+        ap.print_help(sys.stderr)
+        return 2
+    rows = _load_rows(args.file)
+    if not rows:
+        print("no mfu_table found in %s" % args.file, file=sys.stderr)
+        return 1
+    from mxnet_tpu.obs.roofline import render_mfu_table
+
+    print(render_mfu_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
