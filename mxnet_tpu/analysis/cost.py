@@ -10,10 +10,16 @@ this module prices the program —
   decode bench's O(1)-in-prefix assertion uses);
 * **traffic bytes** as the sum of argument + output aval bytes through
   :func:`~mxnet_tpu.analysis.hlo_parse.shape_bytes`'s width table
-  (f8/sub-byte aware — the same table that prices KV caches).  This is
+  (f8/sub-byte aware — the same table that prices KV caches) PLUS the
+  program's collective wire bytes
+  (:func:`~mxnet_tpu.analysis.hlo_parse.stablehlo_collective_stats`
+  over the same lowered text — the MoE all-to-all dispatch/combine,
+  ring ppermutes and Megatron psums all land here, so an
+  expert-parallel step's roofline row prices its exchanges).  This is
   the program's memory-traffic FLOOR: every operand read once, every
-  result written once; intermediates that spill past on-chip memory add
-  to it, so achieved-bytes/s against HBM peak is a lower bound.
+  result written once, every collective payload moved once;
+  intermediates that spill past on-chip memory add to it, so
+  achieved-bytes/s against HBM peak is a lower bound.
 
 Everything here is trace+lower only — no compile, no execution, no
 device work — and runs at table time, never on a hot path.
@@ -35,15 +41,23 @@ def aval_bytes(tree):
 
 
 def program_cost(fn, args):
-    """``{"flops", "bytes"}`` of a ``jax.jit``-wrapped callable at
-    ``args`` (abstract or concrete): dot FLOPs from one trace→lower, and
-    arg+output bytes from the avals.  Callers holding trace-counting
-    instrumentation must arm their probing flag around this (the trace
-    here is a probe, same economics as ``artifact_from_jit``)."""
+    """``{"flops", "bytes", "collective_bytes"}`` of a
+    ``jax.jit``-wrapped callable at ``args`` (abstract or concrete): dot
+    FLOPs from one trace→lower, arg+output bytes from the avals, and
+    collective wire bytes from the lowered StableHLO's explicit
+    collectives (folded into ``bytes`` and broken out separately so the
+    roofline table can show an expert-parallel step's exchange
+    traffic).  Callers holding trace-counting instrumentation must arm
+    their probing flag around this (the trace here is a probe, same
+    economics as ``artifact_from_jit``)."""
     import jax
 
-    from .hlo_parse import dot_flops
+    from .hlo_parse import dot_flops, stablehlo_collective_stats
 
-    flops = dot_flops(fn.trace(*args).lower().as_text())
+    lowered = fn.trace(*args).lower().as_text()
+    flops = dot_flops(lowered)
+    coll = stablehlo_collective_stats(lowered)["total"]["bytes"]
     out = jax.eval_shape(fn, *args)
-    return {"flops": int(flops), "bytes": int(aval_bytes((args, out)))}
+    return {"flops": int(flops),
+            "bytes": int(aval_bytes((args, out))) + int(coll),
+            "collective_bytes": int(coll)}

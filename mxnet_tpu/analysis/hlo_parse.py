@@ -29,6 +29,7 @@ __all__ = [
     "shape_bytes",
     "shape_bytes_report",
     "shape_str",
+    "stablehlo_collective_stats",
 ]
 
 # Bit widths per HLO/StableHLO element type.  Sub-byte types (s4/u4, the
@@ -448,6 +449,80 @@ def input_output_aliases(compiled_text):
             entries.append((path, int(param)))
         return entries
     return []
+
+
+# StableHLO collectives (the LOWERED dialect, before backend
+# legalization): explicit shard_map collectives — the MoE all-to-all
+# dispatch, ring ppermutes, Megatron psums — appear here by name, so the
+# roofline traffic accounting (analysis/cost.py) can price a program's
+# wire bytes with trace+lower only, no compile.  Result types live on
+# the op line (`-> tensor<...>`) except for region-bearing ops
+# (all_reduce / reduce_scatter carry a reduction block), whose signature
+# lands on the region's closing `}) : (...) -> ...` line.
+_SH_COLLECTIVE_RE = re.compile(
+    r"\"?stablehlo\.(all_to_all|all_gather|all_reduce|collective_permute"
+    r"|collective_broadcast|reduce_scatter)\"?\b")
+_SH_RESULT_RE = re.compile(r"->\s*(.+?)\s*$")
+_SH_TENSOR_RE = re.compile(r"tensor<([^>]+)>")
+
+# stablehlo op -> the compiled-HLO spelling, so budget files and reports
+# share one collective vocabulary across both dialects
+_SH_TO_HLO_OP = {
+    "all_to_all": "all-to-all", "all_gather": "all-gather",
+    "all_reduce": "all-reduce", "collective_permute": "collective-permute",
+    "collective_broadcast": "collective-broadcast",
+    "reduce_scatter": "reduce-scatter",
+}
+
+
+def _sh_result_bytes(line):
+    """Total bytes of every tensor<> in the line's `-> ...` result type
+    (tuples sum); None when the line carries no arrow."""
+    m = _SH_RESULT_RE.search(line)
+    if m is None:
+        return None
+    total = 0
+    for spec in _SH_TENSOR_RE.findall(m.group(1)):
+        dims = _tensor_dims(spec)
+        bits = _DTYPE_BITS.get(_tensor_dtype(spec))
+        if bits is None:
+            continue
+        total += (_prod(dims) * bits + 7) // 8
+    return total
+
+
+def stablehlo_collective_stats(stablehlo_text):
+    """Count collectives and sum their result payloads in LOWERED
+    StableHLO text — the same report shape as :func:`collective_stats`
+    ({op: {"count", "bytes"}} + "total"), with ops named in the
+    compiled-HLO spelling so the two dialects share a vocabulary.
+    Region-bearing ops (all_reduce) print their type signature on the
+    region's closing line; a pending queue matches them up (reduction
+    bodies never nest further collectives)."""
+    stats = {}
+    pending = []
+
+    def _note(op, nbytes):
+        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += nbytes or 0
+
+    for line in stablehlo_text.splitlines():
+        m = _SH_COLLECTIVE_RE.search(line)
+        if m is not None:
+            op = _SH_TO_HLO_OP[m.group(1)]
+            nbytes = _sh_result_bytes(line)
+            if nbytes is None:
+                pending.append(op)     # region op: signature comes later
+            else:
+                _note(op, nbytes)
+            continue
+        if pending and line.lstrip().startswith("})") and "->" in line:
+            _note(pending.pop(0), _sh_result_bytes(line))
+    total = {"count": sum(e["count"] for e in stats.values()),
+             "bytes": sum(e["bytes"] for e in stats.values())}
+    stats["total"] = total
+    return stats
 
 
 def collective_stats(hlo_text):

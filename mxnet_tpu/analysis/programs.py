@@ -1,6 +1,6 @@
 """Builders for the canonical programs the lint audits.
 
-``tools/mxlint.py`` (and the tier-1 smoke) checks eleven programs — the
+``tools/mxlint.py`` (and the tier-1 smoke) checks twelve programs — the
 compiled surfaces behind every headline number so far:
 
 * ``train_step``  — the fused forward+backward+optimizer program
@@ -26,6 +26,15 @@ compiled surfaces behind every headline number so far:
   (data, seq, model) mesh: ring attention with head groups sharded on
   'model' (needs >= 4 devices; the smoke forces the 8-virtual-device
   CPU platform, same trick as tests/conftest.py);
+* ``moe_train_step`` — the MoE attention-LM fused step on the composed
+  (data, expert, model) mesh: top-2 capacity-slot routing dispatched
+  through the explicit all-to-all ``shard_map`` program
+  (``ops/moe.py``), expert stacks sharded on 'expert', the FFN hidden
+  dim Megatron-split on 'model' — the collective-budget pass pins the
+  dispatch/combine all-to-all count and bytes (forward AND the
+  custom-VJP backward's reversed exchanges) so a sharding regression
+  that silently degrades the exchange to all-gathers of the full slot
+  table fails CI (needs >= 4 devices, like ``ring_tp_step``);
 * ``ckpt_train_step`` — the fused step of a ``fit()`` run UNDER async
   fenced checkpointing (``mxnet_tpu.elastic``): fences snapshot the
   donated chain and a writer thread lands committed orbax steps while
@@ -60,7 +69,7 @@ __all__ = ["CANONICAL_PROGRAMS", "build_canonical_artifacts"]
 CANONICAL_PROGRAMS = ("train_step", "eval_step", "prefill", "decode_step",
                       "decode_step_q", "draft_step", "verify_step",
                       "paged_decode_step", "paged_verify_step",
-                      "ring_tp_step", "ckpt_train_step")
+                      "ring_tp_step", "moe_train_step", "ckpt_train_step")
 
 # tiny-but-structured dims shared by every builder
 _MLP = dict(batch=8, features=32, hidden=32, classes=8)
@@ -99,19 +108,20 @@ def _mlp_module(compute_dtype="bfloat16"):
     return mod, DataBatch([x], [y])
 
 
-def _lm_symbol():
+def _lm_symbol(**moe_kwargs):
     from mxnet_tpu.models import attention_lm
 
     d = _LM
     return attention_lm.get_symbol(
         vocab_size=d["vocab"], seq_len=d["seq_len"],
         num_layers=d["layers"], embed=d["embed"], heads=d["heads"],
-        ffn_hidden=d["ffn"])
+        ffn_hidden=d["ffn"], **moe_kwargs)
 
 
-def _lm_mesh_module(mesh_cfg):
+def _lm_mesh_module(mesh_cfg, symbol=None):
     """The attention LM bound on a mesh — the ring×TP composition's
-    training program."""
+    training program (or, with a MoE ``symbol``, the expert-parallel
+    one)."""
     import mxnet_tpu as mx
     from mxnet_tpu import ndarray as nd
     from mxnet_tpu.io import DataBatch, DataDesc
@@ -120,8 +130,8 @@ def _lm_mesh_module(mesh_cfg):
 
     d = _LM
     contexts = [mx.cpu(i) for i in range(len(jax.devices()))]
-    mod = mx.mod.Module(_lm_symbol(), context=contexts,
-                        mesh_config=mesh_cfg)
+    mod = mx.mod.Module(symbol if symbol is not None else _lm_symbol(),
+                        context=contexts, mesh_config=mesh_cfg)
     data_desc = DataDesc("data", (d["batch"], d["seq_len"]), layout="NT")
     label_desc = DataDesc("softmax_label", (d["batch"], d["seq_len"]),
                           layout="NT")
@@ -363,8 +373,44 @@ def _ring_mesh_config(n_dev):
     return None
 
 
+def _moe_mesh_config(n_dev):
+    from mxnet_tpu.parallel import MeshConfig
+
+    if n_dev >= 8:
+        return MeshConfig(data=2, expert=2, model=2)
+    if n_dev >= 4:
+        return MeshConfig(data=1, expert=2, model=2)
+    return None
+
+
+def _moe_train_step_artifact():
+    """The expert-parallel MoE LM fused step on the composed
+    (data, expert, model) mesh.
+
+    A 4-expert top-2 capacity-routed attention LM trains two steps at
+    one shape; the explicit all-to-all dispatch (``ops/moe.py``
+    shard_map path) must actually have been taken — a silent fallback
+    to the GSPMD-hint path would let the collective budget drift
+    meaninglessly — so the MOE_PATH tripwire is checked before the
+    artifact snapshots."""
+    from mxnet_tpu.ops.moe import MOE_PATH
+
+    import jax
+
+    cfg = _moe_mesh_config(len(jax.devices()))
+    sym = _lm_symbol(moe_experts=4, moe_capacity_factor=1.25, moe_top_k=2)
+    mod, batch = _lm_mesh_module(cfg, symbol=sym)
+    step = _drive_fused(mod, batch)
+    if MOE_PATH["last"] != "sparse_a2a":
+        raise MXNetError(
+            "MoE fused step did not take the explicit all-to-all "
+            "dispatch (MOE_PATH=%r); the moe_train_step budget would "
+            "not cover the exchange" % (MOE_PATH["last"],))
+    return step.artifact(name="moe_train_step")
+
+
 def build_canonical_artifacts(names=None):
-    """Build the requested canonical artifacts (default: all eleven).
+    """Build the requested canonical artifacts (default: all twelve).
 
     Returns ``(artifacts, notes)`` — ``notes`` maps a program that could
     not be built on this host (e.g. ``ring_tp_step`` without >= 4
@@ -415,6 +461,15 @@ def build_canonical_artifacts(names=None):
 
     if "ckpt_train_step" in want:
         artifacts.append(_ckpt_train_step_artifact())
+
+    if "moe_train_step" in want:
+        if _moe_mesh_config(len(jax.devices())) is None:
+            notes["moe_train_step"] = (
+                "needs >= 4 devices for an (expert, model) mesh; %d "
+                "present — run under the 8-virtual-device CPU platform "
+                "(tools/mxlint.py --smoke does this)" % len(jax.devices()))
+        else:
+            artifacts.append(_moe_train_step_artifact())
 
     if "ring_tp_step" in want:
         cfg = _ring_mesh_config(len(jax.devices()))

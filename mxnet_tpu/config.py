@@ -134,6 +134,28 @@ register("MXNET_RING_DOUBLE_BUFFER", bool, True,
          "the wire time with compute.  0 restores the serial issue order "
          "for A/B measurement (benchmarks/bench_long_context.py records "
          "both).  Schedules are bit-identical in outputs and gradients.")
+register("MXNET_MOE_TOPK", int, 0,
+         "Override the MoEFFN op's num_experts_per_tok attribute at trace "
+         "time (top-k routing: each token is dispatched to its k highest-"
+         "probability experts, gates renormalized over the chosen k when "
+         "k > 1).  0 (default) keeps the per-op attribute; k = 1 is the "
+         "classic switch (top-1) routing with the raw chosen probability "
+         "as the gate.")
+register("MXNET_MOE_DROPLESS", bool, False,
+         "Force the sparse MoE dispatch's overflow policy to 'dropless': "
+         "per-device capacity stretches to the worst case (every local "
+         "choice fits, padding-masked slots carry the slack) so no token "
+         "is ever dropped — at the cost of expert-FFN compute/memory that "
+         "scales like the dense path's worst case.  0 (default) keeps the "
+         "per-op 'overflow' attribute (Switch drop semantics unless the "
+         "symbol says otherwise).")
+register("MXNET_MOE_CAPACITY", float, 0.0,
+         "Override the MoEFFN op's capacity_factor attribute at trace "
+         "time: > 0 arms the sparse capacity-slot dispatch with per-"
+         "(group, expert) capacity ceil(cf * k * group_tokens / E).  "
+         "0 (default) keeps the per-op attribute.  Under an 'expert' mesh "
+         "the sparse path is the explicit all-to-all shard_map program "
+         "(docs/moe.md).")
 register("MXNET_TP_MODE", str, "megatron",
          "Tensor-parallel sharding plan over the 'model' mesh axis: "
          "'megatron' (default) pairs column-parallel with row-parallel "

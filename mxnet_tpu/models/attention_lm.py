@@ -29,7 +29,8 @@ def layer_norm(data, embed, name):
     return sym.broadcast_add(sym.broadcast_mul(normed, gamma), beta)
 
 
-def block(data, embed, heads, ffn_hidden, name, moe_experts=0):
+def block(data, embed, heads, ffn_hidden, name, moe_experts=0,
+          moe_capacity_factor=0.0, moe_top_k=1):
     """One pre-norm decoder block."""
     attn_in = layer_norm(data, embed, name + "_att")
     q = sym.FullyConnected(attn_in, num_hidden=embed, flatten=False,
@@ -45,9 +46,15 @@ def block(data, embed, heads, ffn_hidden, name, moe_experts=0):
 
     ffn_in = layer_norm(data, embed, name + "_ffn")
     if moe_experts > 0:
-        # MoEFFN routes tokens over the trailing axis; (B, T, E) in/out
+        # MoEFFN routes tokens over the trailing axis; (B, T, E) in/out.
+        # capacity_factor > 0 arms the sparse capacity-slot dispatch
+        # (the explicit all-to-all program under an 'expert' mesh);
+        # moe_top_k routes each token to its k best experts.
         ffn = sym.MoEFFN(ffn_in, num_experts=moe_experts,
-                         hidden_size=ffn_hidden, name=name + "_moe")
+                         hidden_size=ffn_hidden,
+                         capacity_factor=moe_capacity_factor,
+                         num_experts_per_tok=moe_top_k,
+                         name=name + "_moe")
     else:
         h = sym.FullyConnected(ffn_in, num_hidden=ffn_hidden, flatten=False,
                                name=name + "_ffn1")
@@ -58,7 +65,8 @@ def block(data, embed, heads, ffn_hidden, name, moe_experts=0):
 
 
 def get_symbol(vocab_size, seq_len, num_layers=2, embed=128, heads=4,
-               ffn_hidden=512, moe_experts=0, **kwargs):
+               ffn_hidden=512, moe_experts=0, moe_capacity_factor=0.0,
+               moe_top_k=1, **kwargs):
     """Decoder-only LM: data (B, T) int tokens, softmax over vocab at every
     position; labels (B, T) next tokens (pad = -1 ignored)."""
     data = sym.Variable("data")
@@ -70,7 +78,9 @@ def get_symbol(vocab_size, seq_len, num_layers=2, embed=128, heads=4,
     net = sym.broadcast_add(net, pos)
     for i in range(num_layers):
         net = block(net, embed, heads, ffn_hidden, "layer%d" % i,
-                    moe_experts=moe_experts)
+                    moe_experts=moe_experts,
+                    moe_capacity_factor=moe_capacity_factor,
+                    moe_top_k=moe_top_k)
     net = layer_norm(net, embed, "final")
     logits = sym.FullyConnected(sym.Reshape(net, shape=(-1, embed)),
                                 num_hidden=vocab_size, name="head")

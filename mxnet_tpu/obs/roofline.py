@@ -165,6 +165,10 @@ class ProgramAccounting:
                    "flops": flops, "bytes": nbytes,
                    "achieved_tflops": None, "achieved_gbps": None,
                    "mfu": None}
+            if cost.get("collective_bytes"):
+                # programs with explicit exchanges (MoE all-to-all, ring
+                # ppermute) break their wire traffic out of the floor
+                row["collective_bytes"] = cost["collective_bytes"]
             if "error" in cost:
                 row["error"] = cost["error"]
             if wall > 0 and calls > 0:
@@ -191,9 +195,13 @@ def _fmt(v, unit=""):
 
 def render_mfu_table(rows):
     """Fixed-width text rendering of :meth:`ProgramAccounting.table`
-    rows (the ``tools/mxstat.py`` output)."""
+    rows (the ``tools/mxstat.py`` output).  The ``collective_bytes``
+    column appears only when some program carries explicit exchanges
+    (MoE all-to-all, ring ppermute)."""
     cols = ("program", "calls", "wall_s", "flops", "bytes",
             "achieved_tflops", "achieved_gbps", "mfu")
+    if any(r.get("collective_bytes") for r in rows):
+        cols = cols + ("collective_bytes",)
     table = [[str(c) for c in cols]]
     for r in rows:
         table.append([_fmt(r.get(c)) for c in cols])

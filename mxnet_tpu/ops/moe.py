@@ -2,24 +2,49 @@
 
 No reference analog (SURVEY §2.5: "Tensor/expert parallelism: not present
 in any form") — this is a leapfrog op like attention.  ``MoEFFN`` is a
-switch-routed (top-1) expert feed-forward layer:
+routed expert feed-forward layer:
 
     gate   = softmax(x @ Wg)                      # (N, E) router
-    choice = argmax(gate)                         # top-1 switch routing
-    y      = gate[choice] * FFN_choice(x)         # scaled expert output
+    choice = top-k(gate)                          # k = num_experts_per_tok
+    y      = sum_k gate_k * FFN_{choice_k}(x)     # gated expert mixture
 
-Dispatch is DENSE (one-hot combine matmuls, no ragged gather): every token
-multiplies against every expert with a 0/1 mask folded into the einsum.
-That is the TPU-friendly formulation — static shapes, MXU-shaped einsums —
-and under the mesh executor the expert-stacked weights (E, ...) shard on
-the 'expert' axis (declared as OpDef ``mesh_axes`` metadata), so GSPMD
-turns the combine einsums into the expert all-to-alls.
+Top-1 (the default) is switch routing with the raw chosen probability as
+the gate; k > 1 renormalizes the chosen gates to sum to one (the
+Mixtral/GShard convention), so k = 1 semantics are unchanged from the
+original switch formulation.
 
-Load balancing: the Switch Transformer auxiliary loss (E · Σ_e f_e·P_e)
-is folded into the op's own gradient through ``jax.custom_vjp`` with
-weight ``aux_loss_coeff`` — backward computes the vjp of
-``y + coeff * aux`` so the router receives balancing pressure without any
-extra loss-head plumbing (set ``aux_loss_coeff=0`` to disable).
+Three dispatch shapes, one routing rule:
+
+* **dense** (``capacity_factor == 0``): one-hot combine matmuls, every
+  expert sees every token — static shapes, MXU-shaped einsums, E× the
+  FFN compute.  The oracle the sparse paths are benchmarked against.
+* **sparse reference** (``capacity_factor > 0``, no 'expert' mesh):
+  capacity-slot dispatch — each expert owns ``C = ceil(cf*k*N_g/E)``
+  slots per token group, tokens past capacity DROP (Switch semantics)
+  unless ``overflow='dropless'`` stretches the capacity to the
+  worst case with a padding mask.  ``num_groups`` splits the tokens into
+  contiguous groups with independent capacity quotas — group g of the
+  reference IS device g of the sharded path, so the two drop identical
+  token sets by construction.
+* **sharded** (``capacity_factor > 0`` under a mesh whose 'expert' axis
+  is > 1): an explicit ``shard_map`` program — each device routes its
+  local tokens, packs them into per-(destination-expert) capacity slots
+  of static shape (E, C_loc, d), exchanges them with
+  ``jax.lax.all_to_all``, runs only its own experts' FFNs (hidden dim
+  optionally Megatron-split over 'model' with one psum), and
+  all-to-alls the outputs back for the combine.  The backward pass —
+  the op-level ``jax.custom_vjp`` below — differentiates through the
+  region, so the two exchanges reappear reversed (an all-to-all's
+  transpose is the opposite-direction all-to-all) instead of hoping
+  GSPMD synthesizes them from sharding hints.  The mxlint collective
+  pass budgets the resulting all-to-all count/bytes per program
+  (benchmarks/budgets.json; docs/moe.md has the workflow).
+
+Load balancing: the Switch auxiliary loss (E · Σ_e f_e·P_e) is folded
+into the op's own gradient through ``jax.custom_vjp`` with weight
+``aux_loss_coeff`` — backward computes the vjp of ``y + coeff * aux`` so
+the router receives balancing pressure without any extra loss-head
+plumbing (set ``aux_loss_coeff=0`` to disable).
 """
 from __future__ import annotations
 
@@ -27,6 +52,11 @@ import numpy as np
 
 from ..attrs import Param, ParamSchema
 from ..registry import OpDef, register_op
+
+# which dispatch shape the last MoEFFN trace used ("dense" | "sparse" |
+# "sparse_a2a") — path-selection tripwire, same pattern as
+# ops.attention.PATH_TAKEN / parallel.ring.RING_PATH
+MOE_PATH = {"last": None}
 
 
 def _moe_shape(attrs, in_shapes, aux_shapes):
@@ -38,104 +68,331 @@ def _moe_shape(attrs, in_shapes, aux_shapes):
     return want, [tuple(x)], []
 
 
-def _moe_forward(x, wg, w1, b1, w2, b2, num_experts):
-    """-> (y, aux_loss): switch-routed expert FFN + Switch balance term."""
+# ---------------------------------------------------------------------------
+# routing + slot assignment — ONE implementation shared by the sparse
+# reference and the shard_map region, so drop sets cannot drift apart
+# ---------------------------------------------------------------------------
+
+def _route(probs, k):
+    """Top-k routing: ``(choice, gate)`` both (n, k).
+
+    k = 1 is switch routing (argmax; gate = the raw chosen probability).
+    k > 1 takes the k highest-probability experts per token and
+    renormalizes the chosen gates to sum to one.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if k == 1:
+        choice = jnp.argmax(probs, axis=-1)[:, None]
+        gate = jnp.take_along_axis(probs, choice, axis=-1)
+        return choice, gate
+    gate, choice = jax.lax.top_k(probs, k)
+    gate = gate / gate.sum(-1, keepdims=True)
+    return choice, gate
+
+
+def _slot_assign(choice, e, cap):
+    """Capacity-slot assignment for one token group.
+
+    Positions are PRIORITY-MAJOR: every token's rank-0 choice is counted
+    before any rank-1 choice (GShard order — a token's second expert can
+    never evict another token's first).  Returns ``(pos, keep, slot)``,
+    all (n, k); ``slot = choice*cap + pos`` clipped into [0, e*cap).
+    Counting runs in int32: an activation-dtype cumsum loses exact
+    integers past 256 and would silently collide slots on big batches.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, k = choice.shape
+    oh = jax.nn.one_hot(choice, e, dtype=jnp.int32)        # (n, k, E)
+    oh_rank_major = oh.transpose(1, 0, 2).reshape(k * n, e)
+    pos = ((jnp.cumsum(oh_rank_major, axis=0) - 1) * oh_rank_major) \
+        .sum(-1).reshape(k, n).T                           # (n, k)
+    keep = pos < cap
+    slot = choice * cap + jnp.minimum(pos, cap - 1)
+    return pos, keep, slot
+
+
+def _pack_slots(xt, slot, keep, e, cap):
+    """Scatter kept tokens into the (E, cap, d) dispatch table (unfilled
+    slots read a zero pad row; the sentinel index e*cap is dropped)."""
+    import jax.numpy as jnp
+
+    n, d = xt.shape
+    k = slot.shape[1]
+    tok = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                           (n, k)).reshape(-1)
+    scatter_idx = jnp.where(keep.reshape(-1), slot.reshape(-1), e * cap)
+    slot_tok = jnp.full((e * cap,), n, jnp.int32) \
+        .at[scatter_idx].set(tok, mode="drop")
+    xpad = jnp.concatenate([xt, jnp.zeros((1, d), xt.dtype)], axis=0)
+    return jnp.take(xpad, slot_tok, axis=0).reshape(e, cap, d)
+
+
+def _combine_slots(flat_out, slot, keep, gate):
+    """Gather each kept (token, rank) choice's slot output, weighted by
+    its gate; dropped choices contribute zero."""
+    import jax.numpy as jnp
+
+    total = flat_out.shape[0]
+    idx = jnp.minimum(slot, total - 1)                     # (n, k)
+    picked = jnp.take(flat_out, idx.reshape(-1), axis=0) \
+        .reshape(idx.shape + (flat_out.shape[-1],))        # (n, k, d)
+    w = (keep.astype(flat_out.dtype) * gate.astype(flat_out.dtype))
+    return (picked * w[..., None]).sum(axis=1)
+
+
+def _expert_ffn(xd, w1, b1, w2, b2):
+    """The relu expert FFN over an (E, C, d) slot table."""
+    import jax.numpy as jnp
+
+    h = jnp.einsum("ecd,edh->ech", xd, w1) + b1[:, None, :]
+    h = jnp.maximum(h, 0.0)
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+
+def _aux_terms(probs, choice, e):
+    """Local (frac, imp) means for the Switch balance loss: f_e = mean
+    routed fraction per choice rank, P_e = mean router probability."""
+    import jax
+    import jax.numpy as jnp
+
+    k = choice.shape[1]
+    oh = jax.nn.one_hot(choice, e, dtype=probs.dtype).sum(1)   # (n, E)
+    return oh.mean(0) / k, probs.mean(0)
+
+
+def _capacity(capacity_factor, k, group_tokens, e, dropless):
+    if dropless:
+        return group_tokens * k
+    return max(1, int(np.ceil(capacity_factor * k * group_tokens / e)))
+
+
+# ---------------------------------------------------------------------------
+# the three dispatch shapes
+# ---------------------------------------------------------------------------
+
+def _moe_forward(x, wg, w1, b1, w2, b2, num_experts, num_experts_per_tok=1):
+    """Dense one-hot dispatch -> (y, aux_loss): every expert sees the
+    masked token batch (the E×-compute oracle the sparse paths beat)."""
     import jax
     import jax.numpy as jnp
 
     e = num_experts
+    k = min(num_experts_per_tok, e)
     orig_shape = x.shape
     d = x.shape[-1]
     xt = x.reshape(-1, d)                       # (N, d) tokens
 
     probs = jax.nn.softmax(xt @ wg, axis=-1)    # (N, E) router
-    choice = jnp.argmax(probs, axis=-1)         # (N,)
-    onehot = jnp.eye(e, dtype=xt.dtype)[choice]  # (N, E) dispatch mask
-    gate = (probs * onehot).sum(-1)             # (N,) chosen prob
+    choice, gate = _route(probs, k)             # (N, k) each
+    onehot_k = jax.nn.one_hot(choice, e, dtype=xt.dtype)   # (N, k, E)
+    dispatch = onehot_k.sum(1)                  # (N, E) 0/1 mask
+    combine = (onehot_k * gate[..., None].astype(xt.dtype)).sum(1)
 
     # dense dispatch: every expert sees the masked token batch; the
     # (E, ...) weight axis is what shards on the 'expert' mesh axis
-    xe = jnp.einsum("nd,ne->end", xt, onehot)   # (E, N, d)
+    xe = jnp.einsum("nd,ne->end", xt, dispatch)  # (E, N, d)
     h = jnp.einsum("end,edh->enh", xe, w1) + b1[:, None, :]
     h = jnp.maximum(h, 0.0)                     # relu expert FFN
     ye = jnp.einsum("enh,ehd->end", h, w2) + b2[:, None, :]
-    y = jnp.einsum("end,ne->nd", ye, onehot)    # combine back to tokens
-    y = y * gate[:, None]
+    y = jnp.einsum("end,ne->nd", ye, combine)   # gated combine
 
     # Switch load-balance loss: E * sum_e f_e * P_e
-    frac = onehot.mean(0)                       # tokens routed per expert
-    imp = probs.mean(0)                         # mean router prob
+    frac, imp = _aux_terms(probs, choice, e)
     aux_loss = (frac * imp).sum() * e
     return y.reshape(orig_shape), aux_loss
 
 
 def _moe_forward_sparse(x, wg, w1, b1, w2, b2, num_experts,
-                        capacity_factor, mesh=None):
+                        capacity_factor, mesh=None, num_experts_per_tok=1,
+                        num_groups=1, dropless=False):
     """Capacity-based sparse dispatch: per-step FLOPs FLAT in num_experts.
 
-    Each expert owns a fixed-capacity slot table C = ceil(cf * N / E); a
-    token takes the next slot of its chosen expert and tokens past
-    capacity are DROPPED (Switch Transformer semantics; the residual
-    connection around the MoE layer carries them).  Dispatch and combine
-    are gathers over a static (E*C) slot table — no (N, E) one-hot
-    matmuls, so the expert FFN compute is 2*cf*N*(dh+hd) regardless of E,
-    where the dense fallback pays E times that.
+    Tokens split into ``num_groups`` contiguous groups; within each group
+    every (token, rank-k choice) takes the next capacity slot of its
+    chosen expert — C = ceil(cf*k*N_g/E) slots per (group, expert) — and
+    choices past capacity are DROPPED (Switch semantics; the residual
+    connection around the MoE layer carries them) unless ``dropless``
+    stretches C to the group's worst case with a padding mask.  Dispatch
+    and combine are gathers over static slot tables — no (N, E) one-hot
+    matmuls, so the expert FFN compute is ~2*cf*k*N*(dh+hd) regardless
+    of E, where the dense oracle pays E times that.
 
-    Under a mesh with an 'expert' axis the expert-major tensors carry
-    explicit sharding constraints, so each device computes only its own
-    experts' slots and GSPMD inserts the token exchange (all-to-all /
-    collective-permute family) at the dispatch/combine boundaries.
+    ``num_groups`` exists because group g IS device g of the sharded
+    all-to-all path (`_moe_forward_sparse_sharded`): called with
+    ``num_groups = data_par * expert_par`` this single-device reference
+    reproduces the sharded program's drop set token for token — the
+    parity the tier-1 suite asserts.  The default (1) is the historical
+    global-cumsum semantics.
+
+    Under a mesh with an 'expert' axis (but taken only when the explicit
+    shard_map path's divisibility guards fail) the expert-major tensors
+    carry sharding constraints so GSPMD may synthesize the exchange.
     """
     import jax
     import jax.numpy as jnp
 
     e = num_experts
+    k = min(num_experts_per_tok, e)
     orig_shape = x.shape
     d = x.shape[-1]
     xt = x.reshape(-1, d)
     n = xt.shape[0]
-    c = max(1, int(np.ceil(capacity_factor * n / e)))
+    g = num_groups
+    assert n % g == 0, "token count %d not divisible into %d groups" % (n, g)
+    ng = n // g
+    cap = _capacity(capacity_factor, k, ng, e, dropless)
 
     probs = jax.nn.softmax(xt @ wg, axis=-1)
-    choice = jnp.argmax(probs, axis=-1)
-    onehot = jax.nn.one_hot(choice, e, dtype=xt.dtype)
-    gate = (probs * onehot).sum(-1)
+    choice_all, gate_all = _route(probs, k)
 
-    # position of each token in its expert's queue (arrival order) —
-    # counted in int32: a bf16 activation-dtype cumsum loses exact
-    # integers past 256 and would silently collide slots on big batches
-    oh32 = onehot.astype(jnp.int32)
-    pos = ((jnp.cumsum(oh32, axis=0) - 1) * oh32).sum(-1)
-    keep = pos < c
-    flat_slot = choice.astype(jnp.int32) * c + jnp.minimum(pos, c - 1)
+    def pack_group(xtg, choiceg, gateg):
+        _, keep, slot = _slot_assign(choiceg, e, cap)
+        xd = _pack_slots(xtg, slot, keep, e, cap)
+        return xd, keep, slot
 
-    # slot -> token table; sentinel n points at a zero pad row
-    scatter_idx = jnp.where(keep, flat_slot, e * c)
-    slot_tok = jnp.full((e * c,), n, jnp.int32) \
-        .at[scatter_idx].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
-    xpad = jnp.concatenate([xt, jnp.zeros((1, d), xt.dtype)], axis=0)
-    xd = jnp.take(xpad, slot_tok, axis=0).reshape(e, c, d)
+    xd_g, keep_g, slot_g = jax.vmap(pack_group)(
+        xt.reshape(g, ng, d), choice_all.reshape(g, ng, k),
+        gate_all.reshape(g, ng, k))             # (g, E, cap, d), ...
 
     if mesh is not None and dict(mesh.shape).get("expert", 1) > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        espec = NamedSharding(mesh, P("expert"))
-        xd = jax.lax.with_sharding_constraint(xd, espec)
-    h = jnp.einsum("ecd,edh->ech", xd, w1) + b1[:, None, :]
-    h = jnp.maximum(h, 0.0)
-    ye = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        espec = NamedSharding(mesh, P(None, "expert"))
+        xd_g = jax.lax.with_sharding_constraint(xd_g, espec)
+    ye_g = jax.vmap(_expert_ffn, in_axes=(0, None, None, None, None))(
+        xd_g, w1, b1, w2, b2)
     if mesh is not None and dict(mesh.shape).get("expert", 1) > 1:
-        ye = jax.lax.with_sharding_constraint(ye, espec)
+        ye_g = jax.lax.with_sharding_constraint(ye_g, espec)
 
-    # combine: each kept token reads back its slot; dropped tokens emit 0
-    flat = ye.reshape(e * c, d)
-    yt = jnp.take(flat, jnp.minimum(flat_slot, e * c - 1), axis=0)
-    yt = yt * keep[:, None].astype(yt.dtype) * gate[:, None]
+    # combine: each kept (token, rank) reads back its slot; drops emit 0
+    yt = jax.vmap(_combine_slots)(
+        ye_g.reshape(g, e * cap, d), slot_g, keep_g,
+        gate_all.reshape(g, ng, k)).reshape(n, d)
 
-    frac = onehot.mean(0)
-    imp = probs.mean(0)
+    frac, imp = _aux_terms(probs, choice_all, e)
     aux_loss = (frac * imp).sum() * e
     return yt.reshape(orig_shape), aux_loss
+
+
+def _moe_forward_sparse_sharded(x, wg, w1, b1, w2, b2, num_experts,
+                                capacity_factor, mesh,
+                                num_experts_per_tok=1, dropless=False):
+    """Explicit expert-parallel dispatch: a ``shard_map`` program over the
+    mesh in which the token exchange is two ``jax.lax.all_to_all`` calls.
+
+    Per device (tokens sharded over ('data', 'expert'), weights over
+    'expert' with the hidden dim Megatron-split over 'model' when it
+    divides):
+
+    1. route the n_loc local tokens (top-k, renormalized gates) and pack
+       them into per-(destination-expert) capacity slots (E, C_loc, d),
+       C_loc = ceil(cf*k*n_loc/E);
+    2. ``all_to_all`` over 'expert' (split the expert dim, concat the
+       capacity dim): each device now holds its OWN experts' full slot
+       tables (E/ep, ep*C_loc, d), source-device-major in the capacity
+       dim;
+    3. run the local experts' FFNs — hidden dim sharded over 'model'
+       with one psum, the Megatron pair;
+    4. ``all_to_all`` back (split capacity, concat experts) and combine
+       each kept token's k slots with its gates.
+
+    Gradients differentiate through the region (the op-level custom_vjp
+    below), so the backward program contains the same two exchanges
+    reversed — d(combine) all-to-alls out to the experts, the FFN
+    backward runs local, and d(dispatch) all-to-alls home — which is
+    what the collective-budget pass pins in benchmarks/budgets.json.
+
+    Token-identical (outputs, grads, drop set) to
+    ``_moe_forward_sparse(..., num_groups=data_par*expert_par)``: group
+    ordering, slot layout and capacity quotas match by construction
+    (shared `_slot_assign`/`_pack_slots`/`_combine_slots` helpers).
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.compat import shard_map
+
+    e = num_experts
+    k = min(num_experts_per_tok, e)
+    orig_shape = x.shape
+    d = x.shape[-1]
+    axes = dict(mesh.shape)
+    dp = axes.get("data", 1)
+    ep = axes["expert"]
+    mp = axes.get("model", 1)
+    h_dim = w1.shape[-1]
+
+    xt = x.reshape(-1, d)
+    n = xt.shape[0]
+    n_loc = n // (dp * ep)
+    cap = _capacity(capacity_factor, k, n_loc, e, dropless)
+    model_ax = "model" if (mp > 1 and h_dim % mp == 0) else None
+    # tokens shard over every axis that exists of (data, expert) —
+    # hand-built meshes without a 'data' name still dispatch
+    tok_axes = tuple(a for a in ("data", "expert") if a in axes)
+
+    def local_moe(xt, wg, w1, b1, w2, b2):
+        import jax.numpy as jnp
+
+        probs = jax.nn.softmax(xt @ wg, axis=-1)
+        choice, gate = _route(probs, k)
+        _, keep, slot = _slot_assign(choice, e, cap)
+        xd = _pack_slots(xt, slot, keep, e, cap)       # (E, C_loc, d)
+
+        # dispatch: expert dim splits across the axis, capacity dims
+        # concat source-device-major -> (E/ep, ep*C_loc, d) local tables
+        xs = lax.all_to_all(xd, "expert", split_axis=0, concat_axis=1,
+                            tiled=True)
+        h = jnp.einsum("ecd,edh->ech", xs, w1) + b1[:, None, :]
+        h = jnp.maximum(h, 0.0)
+        ye = jnp.einsum("ech,ehd->ecd", h, w2)
+        if model_ax is not None:
+            ye = lax.psum(ye, model_ax)                # Megatron row-psum
+        ye = ye + b2[:, None, :]
+        # combine exchange: capacity splits back to source devices,
+        # expert dim concats home -> (E, C_loc, d)
+        ys = lax.all_to_all(ye, "expert", split_axis=1, concat_axis=0,
+                            tiled=True)
+        yt = _combine_slots(ys.reshape(e * cap, d), slot, keep, gate)
+
+        frac, imp = _aux_terms(probs, choice, e)
+        frac = lax.pmean(frac, tok_axes)
+        imp = lax.pmean(imp, tok_axes)
+        aux = (frac * imp).sum() * e
+        return yt, aux
+
+    tok_spec = tok_axes
+    fn = shard_map(
+        local_moe, mesh=mesh,
+        in_specs=(P(tok_spec, None), P(None, None),
+                  P("expert", None, model_ax), P("expert", model_ax),
+                  P("expert", model_ax, None), P("expert", None)),
+        out_specs=(P(tok_spec, None), P()),
+        check_vma=False)
+    yt, aux = fn(xt, wg, w1, b1, w2, b2)
+    return yt.reshape(orig_shape), aux
+
+
+def _sharded_ok(x, num_experts, mesh):
+    """The explicit all-to-all path's static divisibility guards: an
+    'expert' axis > 1, experts divisible over it, and the flattened
+    token count divisible over (data x expert).  Indivisible configs
+    degrade to the GSPMD-hint sparse path, never to wrong numbers."""
+    if mesh is None:
+        return False
+    axes = dict(mesh.shape)
+    ep = axes.get("expert", 1)
+    if ep <= 1 or num_experts % ep != 0:
+        return False
+    n = 1
+    for dim in x.shape[:-1]:
+        n *= dim
+    return n % (axes.get("data", 1) * ep) == 0
 
 
 def register_all():
@@ -143,27 +400,43 @@ def register_all():
 
     _wrapped = {}
 
-    def _moe_with_aux_grad(num_experts, coeff, capacity_factor, mesh):
+    def _moe_with_aux_grad(num_experts, coeff, capacity_factor, mesh,
+                           num_experts_per_tok, dropless):
         """custom_vjp wrapper: forward value is y alone; backward is the
         vjp of (y + coeff * aux_loss), i.e. training minimizes
-        task_loss + coeff * balance_loss with exact gradients."""
+        task_loss + coeff * balance_loss with exact gradients.  For the
+        sharded sparse path the vjp differentiates through the shard_map
+        region, so the backward program carries the two all-to-all
+        exchanges in reverse."""
         # key by the mesh's VALUE (axes + device ids), not id(): id-keying
         # grows the cache (and pins a Mesh) for every rebind in a
         # long-running job; equal meshes share one traced closure
         mesh_key = None if mesh is None else (
             tuple(mesh.shape.items()),
             tuple(d.id for d in mesh.devices.flat))
-        key = (num_experts, coeff, capacity_factor, mesh_key)
+        key = (num_experts, coeff, capacity_factor, mesh_key,
+               num_experts_per_tok, dropless)
         fn = _wrapped.get(key)
         if fn is not None:
             return fn
 
         def fwd_impl(x, wg, w1, b1, w2, b2):
-            if capacity_factor > 0:
-                return _moe_forward_sparse(x, wg, w1, b1, w2, b2,
-                                           num_experts, capacity_factor,
-                                           mesh)
-            return _moe_forward(x, wg, w1, b1, w2, b2, num_experts)
+            if capacity_factor > 0 or dropless:
+                if _sharded_ok(x, num_experts, mesh):
+                    MOE_PATH["last"] = "sparse_a2a"
+                    return _moe_forward_sparse_sharded(
+                        x, wg, w1, b1, w2, b2, num_experts,
+                        capacity_factor, mesh,
+                        num_experts_per_tok=num_experts_per_tok,
+                        dropless=dropless)
+                MOE_PATH["last"] = "sparse"
+                return _moe_forward_sparse(
+                    x, wg, w1, b1, w2, b2, num_experts, capacity_factor,
+                    mesh, num_experts_per_tok=num_experts_per_tok,
+                    dropless=dropless)
+            MOE_PATH["last"] = "dense"
+            return _moe_forward(x, wg, w1, b1, w2, b2, num_experts,
+                                num_experts_per_tok=num_experts_per_tok)
 
         @jax.custom_vjp
         def moe(x, wg, w1, b1, w2, b2):
@@ -190,10 +463,19 @@ def register_all():
         return moe
 
     def fcompute(attrs, inputs, aux, octx):
+        from .. import config as _config
+
+        # runtime knobs override the symbol's attributes at trace time
+        # (flip routing/capacity/overflow without editing the model)
+        k = int(_config.get("MXNET_MOE_TOPK")) \
+            or int(attrs.get("num_experts_per_tok", 1))
+        cf = float(_config.get("MXNET_MOE_CAPACITY")) \
+            or float(attrs["capacity_factor"])
+        dropless = bool(_config.get("MXNET_MOE_DROPLESS")) \
+            or attrs.get("overflow", "drop") == "dropless"
         fn = _moe_with_aux_grad(attrs["num_experts"],
                                 float(attrs["aux_loss_coeff"]),
-                                float(attrs["capacity_factor"]),
-                                octx.mesh)
+                                cf, octx.mesh, k, dropless)
         return [fn(*inputs)], []
 
     register_op(OpDef(
@@ -206,10 +488,25 @@ def register_all():
                       "into the backward pass; 0 disables"),
             Param("capacity_factor", float, default=0.0,
                   doc="> 0 enables SPARSE capacity-based dispatch: each "
-                      "expert processes at most ceil(cf*N/E) tokens "
-                      "(overflow tokens drop, Switch semantics) and the "
-                      "per-step FLOPs are flat in num_experts; 0 keeps "
-                      "the dense all-expert oracle"),
+                      "expert processes at most ceil(cf*k*N_g/E) tokens "
+                      "per token group (overflow drops, Switch "
+                      "semantics, unless overflow='dropless') and the "
+                      "per-step FLOPs are flat in num_experts; under an "
+                      "'expert' mesh the dispatch is an explicit "
+                      "all-to-all shard_map program (docs/moe.md); 0 "
+                      "keeps the dense all-expert oracle"),
+            Param("num_experts_per_tok", int, default=1,
+                  doc="top-k routing: experts per token (gates "
+                      "renormalized over the chosen k when k > 1; 1 = "
+                      "classic switch top-1 with the raw probability "
+                      "gate).  MXNET_MOE_TOPK overrides at trace time"),
+            Param("overflow", str, default="drop",
+                  doc="sparse-path overflow policy: 'drop' (Switch "
+                      "semantics — past-capacity tokens emit zero and "
+                      "ride the residual) or 'dropless' (capacity "
+                      "stretches to the per-device worst case with a "
+                      "padding mask, no drops ever).  "
+                      "MXNET_MOE_DROPLESS=1 forces 'dropless'"),
         ),
         num_inputs=6,
         arguments=["data", "gate_weight", "expert1_weight",
@@ -217,10 +514,11 @@ def register_all():
         infer_shape=_moe_shape,
         mesh_axes={"expert1_weight": "expert", "expert1_bias": "expert",
                    "expert2_weight": "expert", "expert2_bias": "expert"},
-        doc="Switch-routed (top-1) mixture-of-experts feed-forward.  "
+        doc="Top-k-routed mixture-of-experts feed-forward.  "
             "Leapfrog op (SURVEY §2.5: expert parallelism 'not present'): "
             "expert-stacked weights (E, ...) shard on the 'expert' mesh "
-            "axis; dense one-hot dispatch keeps shapes static for XLA; "
-            "the Switch balance loss rides the backward pass "
+            "axis; capacity_factor > 0 under an 'expert' mesh dispatches "
+            "through the explicit all-to-all shard_map program; the "
+            "Switch balance loss rides the backward pass "
             "(aux_loss_coeff)."),
         aliases=("_contrib_MoEFFN",))

@@ -230,6 +230,62 @@ def test_bench_decode_smoke_contract():
     assert paged_row["spec_steps"] > 0
 
 
+def test_bench_moe_smoke_contract():
+    """`benchmarks/bench_moe.py --smoke` drives the expert-parallel MoE
+    LM fused step (explicit all-to-all dispatch over the 8-virtual-device
+    'expert' mesh) AND the dense one-hot-dispatch oracle at tiny dims,
+    and must emit the bench.py metric contract plus the MoE accounting:
+    the traced dispatch path, the all-to-all count/bytes from compiled
+    HLO (the same surface the mxlint collective-budget pass ceilings),
+    and the per-program mfu_table rows whose expert-parallel row carries
+    collective_bytes.  The >= 2x vs-dense acceptance line is asserted by
+    the bench's own full-dims run; the smoke only pins the deterministic
+    halves (this harness's wall clock is shared-machine noise)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # scrub inherited bench/MoE knobs so the smoke measures the defaults
+    for key in [k for k in env if k.startswith("BENCH_")
+                or k.startswith("MXNET_MOE_")]:
+        env.pop(key)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "bench_moe.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    head = json.loads(lines[0])
+    assert head["metric"].startswith("moe_lm_tokens_per_sec_e")
+    assert head["unit"] == "tok/s"
+    assert head["value"] > 0
+    # the ratio is REPORTED at smoke dims, asserted only at full dims
+    assert head["vs_baseline"] > 0 and head["vs_dense_dispatch"] > 0
+    assert head["dense_tokens_per_sec"] > 0
+    # the exchange is explicit: all-to-alls in the compiled fused step
+    assert head["all_to_all_count"] > 0, head
+    assert head["all_to_all_bytes"] > 0, head
+    assert head["num_experts_per_tok"] >= 2, head
+    # stderr: both configs ran, the sparse one on the shard_map path
+    rows = {r["config"]: r for r in
+            (json.loads(ln) for ln in proc.stderr.splitlines()
+             if ln.strip().startswith("{")) if "config" in r}
+    assert rows["moe_a2a"]["moe_path"] == "sparse_a2a", rows
+    assert rows["dense_dispatch"]["moe_path"] == "dense", rows
+    assert rows["dense_dispatch"].get("all_to_all_count", 0) == 0, rows
+    # the roofline join: the expert-parallel step's row exists, carries
+    # statics, and breaks out its exchange traffic; the dense oracle's
+    # row shows the E× FLOP bill the capacity path avoids
+    mfu = {r["program"]: r for r in head["mfu_table"]}
+    for prog in ("moe_train_step", "moe_dense_train_step"):
+        assert prog in mfu, sorted(mfu)
+        assert mfu[prog]["calls"] > 0 and mfu[prog]["wall_s"] > 0
+        assert mfu[prog]["flops"] > 0 and mfu[prog]["bytes"] > 0
+    assert mfu["moe_train_step"]["collective_bytes"] > 0, mfu
+    assert mfu["moe_train_step"]["flops"] * 2 <= \
+        mfu["moe_dense_train_step"]["flops"], mfu
+
+
 def test_mxstat_smoke_contract():
     """`tools/mxstat.py --smoke` must self-check the telemetry machinery
     (concurrent counter sums, numpy-exact histogram percentiles, the
@@ -264,12 +320,14 @@ def test_mxstat_smoke_contract():
 
 
 def test_mxlint_smoke_contract():
-    """`tools/mxlint.py --smoke` must audit all eleven canonical programs
+    """`tools/mxlint.py --smoke` must audit all twelve canonical programs
     (the speculative trio — draft_step / verify_step / decode_step_q —
     driven by a real mixed-length speculative serve; the paged pair —
     paged_decode_step / paged_verify_step — by a real shared-prefix
     paged serve with chunked prefill, COW forks and retirements;
-    ckpt_train_step by a real fit under async fenced checkpointing) with
+    ckpt_train_step by a real fit under async fenced checkpointing;
+    moe_train_step by a real top-2 capacity-routed MoE LM step whose
+    explicit all-to-all dispatch the collective pass budgets) with
     all six passes and report ZERO unsuppressed findings — the
     static-analysis acceptance line: donation aliasing, collective
     budgets, retrace counts, host-sync lint, FLOP/dtype coverage and
@@ -296,15 +354,22 @@ def test_mxlint_smoke_contract():
     assert head["unit"] == "findings"
     assert head["value"] == 0 and head["vs_baseline"] == 1.0, head
     assert head["errors"] == 0 and head["warnings"] == 0, head
-    # every canonical program was built (the virtual mesh gives ring×TP)
-    assert head["programs"] == 11 and head["passes"] == 6, head
+    # every canonical program was built (the virtual mesh gives ring×TP
+    # and the expert-parallel MoE step)
+    assert head["programs"] == 12 and head["passes"] == 6, head
     assert head["skipped_programs"] == [], head
 
     # stderr: one JSON finding per line; every (pass, program) pair ran
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     pairs = {(r["pass"], r["program"]) for r in rows if "pass" in r}
-    assert len(pairs) == 66, sorted(pairs)
+    assert len(pairs) == 72, sorted(pairs)
+    # the expert-parallel step's committed all-to-all ceiling is live:
+    # the collective pass measured real exchanges within budget
+    a2a_row = next(r for r in rows
+                   if r.get("pass") == "collective-budget"
+                   and r.get("program") == "moe_train_step")
+    assert a2a_row["severity"] == "info", a2a_row
     assert all(r["severity"] == "info" for r in rows if "pass" in r), rows
     # the quantized decode/verify programs really carry narrow caches
     # within their committed ceilings (not the f32 fallback)

@@ -117,6 +117,88 @@ def test_done_lines_not_double_counted():
 
 
 # ---------------------------------------------------------------------------
+# collective_stats: all-to-all extraction (the MoE dispatch/combine op —
+# the regex matched for years with zero coverage; these pin it)
+# ---------------------------------------------------------------------------
+def test_all_to_all_sync_counted():
+    hlo = """
+  %a2a = f32[8,16]{1,0} all-to-all(f32[8,16]{1,0} %x), replica_groups={{0,1,2,3}}, dimensions={0}
+  %a2a.done.decoy = f32[8,16]{1,0} add(f32[8,16]{1,0} %a2a, f32[8,16]{1,0} %a2a)
+"""
+    st = collective_stats(hlo)
+    assert st["all-to-all"] == {"count": 1, "bytes": 8 * 16 * 4}
+    assert st["overlappable"] == {"count": 0, "bytes": 0}
+
+
+def test_all_to_all_sync_tuple_operands_sum():
+    # multi-operand sync all-to-all carries a tuple result: every buffer
+    # is real exchanged payload, so the bytes sum over the tuple
+    hlo = """
+  %a2a.t = (f32[4,8]{1,0}, bf16[4,8]{1,0}) all-to-all(f32[4,8]{1,0} %x, bf16[4,8]{1,0} %y), replica_groups={{0,1},{2,3}}, dimensions={1}
+"""
+    st = collective_stats(hlo)
+    assert st["all-to-all"] == {"count": 1,
+                                "bytes": 4 * 8 * 4 + 4 * 8 * 2}
+
+
+def test_all_to_all_async_start_done_pair_counts_once():
+    # async pair: the -start carries ((operands), result[, ctx]) — count
+    # the result once, mark it overlappable, never count the -done
+    hlo = """
+  %a2a-start = ((f32[2,64]{1,0:T(8,128)}), f32[2,64]{1,0:T(8,128)}) all-to-all-start(f32[2,64]{1,0:T(8,128)} %p0), replica_groups={{0,1,2,3}}, dimensions={1}
+  %a2a-done = f32[2,64]{1,0:T(8,128)} all-to-all-done(((f32[2,64]{1,0:T(8,128)}), f32[2,64]{1,0:T(8,128)}) %a2a-start)
+"""
+    st = collective_stats(hlo)
+    assert st["all-to-all"] == {"count": 1, "bytes": 2 * 64 * 4}
+    assert st["overlappable"] == {"count": 1, "bytes": 2 * 64 * 4}
+    assert st["total"]["count"] == 1
+
+
+def test_all_to_all_async_grouped_tuple_result():
+    # grouped async form: operand pack and result pack are both tuples;
+    # the result tuple's buffers all count (sum), the operand pack never
+    hlo = """
+  %a2a-start.2 = ((f32[4]{0}, f32[8]{0}), (f32[4]{0}, f32[8]{0})) all-to-all-start(f32[4]{0} %a, f32[8]{0} %b), replica_groups={{0,1}}
+  %a2a-done.2 = (f32[4]{0}, f32[8]{0}) all-to-all-done(((f32[4]{0}, f32[8]{0}), (f32[4]{0}, f32[8]{0})) %a2a-start.2)
+"""
+    st = collective_stats(hlo)
+    assert st["all-to-all"] == {"count": 1, "bytes": 4 * 4 + 8 * 4}
+
+
+# ---------------------------------------------------------------------------
+# stablehlo_collective_stats: the LOWERED dialect (analysis/cost.py's
+# traffic accounting for explicit shard_map exchanges)
+# ---------------------------------------------------------------------------
+def test_stablehlo_collectives_one_line_ops():
+    from mxnet_tpu.analysis.hlo_parse import stablehlo_collective_stats
+
+    txt = """
+    %0 = "stablehlo.all_to_all"(%arg0) <{concat_dimension = 1 : i64, replica_groups = dense<[[0, 1, 2, 3]]> : tensor<1x4xi64>, split_count = 4 : i64, split_dimension = 0 : i64}> : (tensor<8x2x6xf32>) -> tensor<2x8x6xf32>
+    %1 = "stablehlo.collective_permute"(%0) <{source_target_pairs = dense<[[0, 1]]> : tensor<1x2xi64>}> : (tensor<2x8x6xf32>) -> tensor<2x8x6xf32>
+"""
+    st = stablehlo_collective_stats(txt)
+    assert st["all-to-all"] == {"count": 1, "bytes": 2 * 8 * 6 * 4}
+    assert st["collective-permute"] == {"count": 1, "bytes": 2 * 8 * 6 * 4}
+    assert st["total"]["count"] == 2
+
+
+def test_stablehlo_all_reduce_region_signature_on_closing_line():
+    # region-bearing ops print their type signature on the region's
+    # closing line; the pending queue must match them up
+    from mxnet_tpu.analysis.hlo_parse import stablehlo_collective_stats
+
+    txt = """
+    %2 = "stablehlo.all_reduce"(%1) <{replica_groups = dense<[[0, 1]]> : tensor<1x2xi64>}> ({
+    ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+      %s = stablehlo.add %a, %b : tensor<f32>
+      stablehlo.return %s : tensor<f32>
+    }) : (tensor<16x4xbf16>) -> tensor<16x4xbf16>
+"""
+    st = stablehlo_collective_stats(txt)
+    assert st["all-reduce"] == {"count": 1, "bytes": 16 * 4 * 2}
+
+
+# ---------------------------------------------------------------------------
 # dot_flops: dialect coverage + uncounted-op reporting
 # ---------------------------------------------------------------------------
 def test_dot_flops_stablehlo_dot_general():

@@ -2,13 +2,15 @@
 """mxlint — static program-analysis lint over the framework's canonical
 compiled programs.
 
-Builds the eleven canonical programs on the current backend (``--smoke``
-forces the 8-virtual-device CPU platform so the ring×TP mesh program
-exists on one box; the speculative trio — draft_step / verify_step /
-decode_step_q — is driven by a real mixed-length speculative serve, and
-the paged pair — paged_decode_step / paged_verify_step — by a real
-shared-prefix paged serve, and ckpt_train_step by a real fit under async fenced
-checkpointing), snapshots each as a
+Builds the twelve canonical programs on the current backend (``--smoke``
+forces the 8-virtual-device CPU platform so the ring×TP and
+expert-parallel MoE mesh programs exist on one box; the speculative
+trio — draft_step / verify_step / decode_step_q — is driven by a real
+mixed-length speculative serve, the paged pair — paged_decode_step /
+paged_verify_step — by a real shared-prefix paged serve, ckpt_train_step
+by a real fit under async fenced checkpointing, and moe_train_step by a
+real top-2 capacity-routed MoE LM step whose explicit all-to-all
+dispatch the collective pass budgets), snapshots each as a
 :class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` (jaxpr + lowered
 StableHLO + compiled HLO + donation/retrace/dtype/cache metadata), and
 runs the six analysis passes against the committed budget file:
@@ -80,7 +82,7 @@ def _parse_args(argv):
         "compiled programs (see docs/static_analysis.md)")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 CI mode: force the 8-virtual-device CPU "
-                    "platform and audit all ten programs")
+                    "platform and audit all twelve programs")
     ap.add_argument("--programs", default="",
                     help="comma-filter of canonical programs (default all)")
     ap.add_argument("--budgets", default="",
