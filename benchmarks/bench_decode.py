@@ -37,6 +37,15 @@ attention-LM generating tokens through ``mxnet_tpu.decode`` —
   reach >= 2x the dense-ring tokens/s/GB at full dims (T=2048) — memory
   is the serving bottleneck PagedAttention removes.
 
+* **pallas_decode** — static attention-traffic pricing of the paged
+  decode step, einsum vs the fused Pallas flash-decoding kernel
+  (``MXNET_PALLAS_DECODE``, ops/pallas_decode.py): attention bytes = one
+  pool pass + materialized gather intermediates
+  (``analysis.cost.program_cost``'s gather_bytes term).  Published as
+  ``decode_attn_bytes_per_token`` (+ per-path variants and the ratio) and
+  ``pallas_decode_enabled``; non-smoke asserts the fused path prices
+  <= 0.5x the einsum path's bytes at T=2048 — the mfu_table traffic win.
+
 The bench also ASSERTS the O(1)-in-prefix property statically: dot FLOPs
 (``parallel.hlo_stats.dot_flops``) of the lowered decode-step program must
 not grow with the prefix, while the full-forward program's roughly double
@@ -369,6 +378,65 @@ def main():
             "paged serve is %.2fx the dense-ring tokens/s/GB " \
             "(acceptance: >= 2x at T=%d)" % (vs_pr6_per_gb, t)
 
+    # ---- fused flash-decoding kernel: priced attention traffic ---------
+    # Static pricing only (trace+lower, no execution, so it is exact and
+    # machine-noise-free even in --smoke): the paged decode step's
+    # attention traffic = one pass over the shared KV pool PLUS any
+    # materialized gather intermediates.  The einsum path's paged_gather
+    # writes (and its attention re-reads) a full (B, M*pt, E) dense-ring
+    # view per K and V per layer — program_cost's gather_bytes term; the
+    # fused Pallas kernel (MXNET_PALLAS_DECODE) walks the page table
+    # inside the kernel and has no such gather, so its priced bytes must
+    # drop >= 2x — the mfu_table row ISSUE-11's acceptance pins.
+    from mxnet_tpu import config as _cfg
+    from mxnet_tpu.analysis.cost import program_cost
+    from mxnet_tpu.ops.attention import decode_kernel_mode
+
+    def _price_decode_attn(arm):
+        knobs = {"MXNET_PALLAS_DECODE": "1" if arm else "0"}
+        if arm and not on_tpu:
+            knobs["MXNET_PALLAS_INTERPRET"] = "1"
+        with _cfg.overrides(**knobs):
+            pp2 = DecodePredictor(
+                sym, params, cache_len=paged_cap, temperature=0.0,
+                kv_dtype=kv_dtype, paged=True, page_tokens=page_tokens,
+                pool_pages=pool_pages)
+            st = pp2.paged_batch_state(slots)
+            tables, active = pp2._paged_probe_args(st)
+            pp2._probing = True
+            try:
+                cost = program_cost(
+                    pp2._decode_fn, (pp2._env, st, tables, active, key))
+            finally:
+                pp2._probing = False
+            return pp2.pool_bytes() + cost["gather_bytes"], cost
+
+    attn_einsum, cost_e = _price_decode_attn(False)
+    attn_fused, cost_f = _price_decode_attn(True)
+    # what the TIMED serve phases above actually dispatched (the ambient
+    # config: TPU rigs arm MXNET_PALLAS_DECODE; the CPU-harness smoke
+    # keeps the einsum path — interpret-mode kernels would measure the
+    # Pallas interpreter, not the serving loop)
+    pallas_enabled = bool(decode_kernel_mode()[0])
+    attn_active = attn_fused if pallas_enabled else attn_einsum
+    attn_ratio = attn_einsum / max(attn_fused, 1)
+    emit({"phase": "pallas_decode",
+          "pallas_decode_enabled": pallas_enabled,
+          "decode_attn_bytes_einsum": attn_einsum,
+          "decode_attn_bytes_fused": attn_fused,
+          "gather_bytes_einsum": cost_e["gather_bytes"],
+          "gather_bytes_fused": cost_f["gather_bytes"],
+          "program_bytes_einsum": cost_e["bytes"],
+          "program_bytes_fused": cost_f["bytes"],
+          "attn_bytes_ratio": round(attn_ratio, 3)})
+    if not SMOKE:
+        # the kernel acceptance line at full dims (T=2048): fusing
+        # gather + dequant + attention into one HBM pass must at least
+        # halve the decode step's priced attention bytes
+        assert attn_fused * 2 <= attn_einsum, \
+            "fused decode attention prices %d bytes vs einsum %d " \
+            "(acceptance: <= 0.5x at T=%d)" % (attn_fused, attn_einsum, t)
+
     print(json.dumps({
         "metric": "decode_tokens_per_sec_t%d" % t,
         "value": round(decode_tok_s, 1),
@@ -394,6 +462,11 @@ def main():
         "pool_bytes": ppred.pool_bytes(),
         "decode_step_dot_flops": f_decode,
         "full_forward_dot_flops": f_full,
+        "pallas_decode_enabled": pallas_enabled,
+        "decode_attn_bytes_per_token": round(attn_active / slots, 1),
+        "decode_attn_bytes_per_token_einsum": round(attn_einsum / slots, 1),
+        "decode_attn_bytes_per_token_fused": round(attn_fused / slots, 1),
+        "decode_attn_bytes_ratio": round(attn_ratio, 3),
     }))
 
 

@@ -6,6 +6,15 @@ Times fwd+bwd of a ResNet-50-ish conv/BN/relu stack under three layouts:
   nhwc_full - whole stack natively NHWC/HWIO
 
 Run on the bench chip to decide how ops/nn.py should lay out convs.
+
+``--kv`` probes KV CACHE POOL layouts instead (the ROADMAP's
+wire-the-probe clause): decode attention over a paged (P, page_tokens,
+E) pool is timed with the pool ``device_put`` under each candidate
+``major_to_minor`` permutation, and the winner prints as the
+``MXNET_KV_LAYOUT`` value to export — decode.DecodePredictor applies it
+to every pool at allocation (``ops.attention.apply_kv_layout``).
+Backends that refuse a layout request (XLA:CPU) report it and keep the
+native row-major; the knob is then best left empty.
 """
 import functools
 import time
@@ -115,7 +124,85 @@ def bench(mode, iters=10):
     return dt
 
 
+def _kv_place(buf, order):
+    """device_put ``buf`` with the requested major_to_minor order (None =
+    backend native).  Raises if the backend refuses the layout."""
+    if order is None:
+        return jax.device_put(buf, jax.devices()[0])
+    from jax.experimental.layout import DeviceLocalLayout, Layout
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.device_put(buf, Layout(
+        DeviceLocalLayout(major_to_minor=tuple(order)),
+        SingleDeviceSharding(jax.devices()[0])))
+
+
+def bench_kv(iters=30):
+    """Time one paged decode-attention step per candidate pool layout.
+
+    Serving-shaped dims: B slots of a T-token cache in page_tokens pages,
+    decode batch = slots (the bandwidth-bound shape the fused kernel and
+    the einsum path both stream).  The SAME jitted program runs for every
+    candidate; only the pool's device layout changes, so the delta IS the
+    layout.  Prints the winner as an ``export MXNET_KV_LAYOUT=...`` line
+    (empty = native wins or the backend refuses overrides)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import numpy as np
+
+    from mxnet_tpu.ops import attention as attn
+
+    b, t_cache, e, heads, pt = 8, 2048, 1024, 8, 16
+    m = t_cache // pt
+    pages = b * m + 1
+    rng = np.random.RandomState(0)
+    kp = jnp.asarray(rng.randn(pages, pt, e).astype(np.float32))
+    vp = jnp.asarray(rng.randn(pages, pt, e).astype(np.float32))
+    table = jnp.asarray(
+        1 + (np.arange(b)[:, None] * m + np.arange(m)[None, :]), jnp.int32)
+    lens = jnp.full((b,), t_cache, jnp.int32)
+    q = jnp.asarray(rng.randn(b, 1, e).astype(np.float32))
+
+    fn = jax.jit(lambda q_, k_, v_, t_, l_: attn.paged_attend(
+        q_, k_, v_, t_, l_, num_heads=heads))
+
+    candidates = [("native", None), ("0,1,2", (0, 1, 2)),
+                  ("1,0,2", (1, 0, 2)), ("2,1,0", (2, 1, 0)),
+                  ("0,2,1", (0, 2, 1))]
+    results = []
+    for name, order in candidates:
+        try:
+            kpl, vpl = _kv_place(kp, order), _kv_place(vp, order)
+        except Exception as exc:
+            print("%-8s unsupported on this backend (%s)"
+                  % (name, str(exc)[:80]))
+            continue
+        out = fn(q, kpl, vpl, table, lens)
+        float(jnp.sum(out))                       # sync fence
+        tic = time.time()
+        for _ in range(iters):
+            out = fn(q, kpl, vpl, table, lens)
+        float(jnp.sum(out))
+        dt = (time.time() - tic) / iters
+        print("%-8s %8.3f ms/step  %8.1f GB/s pool-stream"
+              % (name, dt * 1e3,
+                 2 * pages * pt * e * 4 / dt / 1e9))
+        results.append((dt, name))
+    if results:
+        best = min(results)[1]
+        print("winner: %s" % best)
+        print("export MXNET_KV_LAYOUT=%s"
+              % ("" if best == "native" else best))
+
+
 if __name__ == "__main__":
+    import sys
+
     print("device:", jax.devices()[0].device_kind)
-    for mode in ("nchw", "nhwc_wrap", "nhwc_full"):
-        bench(mode)
+    if "--kv" in sys.argv:
+        bench_kv()
+    else:
+        for mode in ("nchw", "nhwc_wrap", "nhwc_full"):
+            bench(mode)

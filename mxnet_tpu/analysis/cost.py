@@ -41,23 +41,33 @@ def aval_bytes(tree):
 
 
 def program_cost(fn, args):
-    """``{"flops", "bytes", "collective_bytes"}`` of a
+    """``{"flops", "bytes", "collective_bytes", "gather_bytes"}`` of a
     ``jax.jit``-wrapped callable at ``args`` (abstract or concrete): dot
-    FLOPs from one trace→lower, arg+output bytes from the avals, and
+    FLOPs from one trace→lower, arg+output bytes from the avals,
     collective wire bytes from the lowered StableHLO's explicit
-    collectives (folded into ``bytes`` and broken out separately so the
-    roofline table can show an expert-parallel step's exchange
-    traffic).  Callers holding trace-counting instrumentation must arm
-    their probing flag around this (the trace here is a probe, same
-    economics as ``artifact_from_jit``)."""
+    collectives, and materialized-gather intermediate bytes
+    (:func:`~mxnet_tpu.analysis.hlo_parse.stablehlo_gather_stats`:
+    2x each gather result — one write, one re-read).  The last term is
+    what prices the einsum decode path honestly: ``paged_gather``'s
+    (B, M*page_tokens, E) dense-ring view of the KV pool is the largest
+    intermediate in the serving system and is invisible to arg/output
+    accounting, which understated decode bytes and OVERstated decode MFU
+    until ISSUE-11.  Both extras fold into ``bytes`` and break out
+    separately so the roofline table can show them.  Callers holding
+    trace-counting instrumentation must arm their probing flag around
+    this (the trace here is a probe, same economics as
+    ``artifact_from_jit``)."""
     import jax
 
-    from .hlo_parse import dot_flops, stablehlo_collective_stats
+    from .hlo_parse import (dot_flops, stablehlo_collective_stats,
+                            stablehlo_gather_stats)
 
     lowered = fn.trace(*args).lower().as_text()
     flops = dot_flops(lowered)
     coll = stablehlo_collective_stats(lowered)["total"]["bytes"]
+    gath = stablehlo_gather_stats(lowered)["bytes"]
     out = jax.eval_shape(fn, *args)
     return {"flops": int(flops),
-            "bytes": int(aval_bytes((args, out))) + int(coll),
-            "collective_bytes": int(coll)}
+            "bytes": int(aval_bytes((args, out))) + int(coll) + int(gath),
+            "collective_bytes": int(coll),
+            "gather_bytes": int(gath)}

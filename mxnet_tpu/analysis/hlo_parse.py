@@ -30,6 +30,7 @@ __all__ = [
     "shape_bytes_report",
     "shape_str",
     "stablehlo_collective_stats",
+    "stablehlo_gather_stats",
 ]
 
 # Bit widths per HLO/StableHLO element type.  Sub-byte types (s4/u4, the
@@ -43,6 +44,12 @@ _DTYPE_BITS = {
     "s64": 64, "u64": 64, "s32": 32, "u32": 32, "s16": 16, "u16": 16,
     "s8": 8, "u8": 8, "s4": 4, "u4": 4, "s2": 2, "u2": 2,
     "pred": 8, "c64": 64, "c128": 128,
+    # StableHLO spells integers signless (i8, not s8) and bools i1 —
+    # the lowered-dialect byte accounting (collective payloads, gather
+    # intermediates) reads these; compiled HLO never produces them.
+    # i1 is stored one byte per element, like pred.
+    "i64": 64, "i32": 32, "i16": 16, "i8": 8, "i4": 4, "i2": 2, "i1": 8,
+    "ui64": 64, "ui32": 32, "ui16": 16, "ui8": 8, "ui4": 4, "ui2": 2,
 }
 
 # dtype-shaped names only — 'pred', 'bf16', or letter-digit-led tokens
@@ -523,6 +530,42 @@ def stablehlo_collective_stats(stablehlo_text):
              "bytes": sum(e["bytes"] for e in stats.values())}
     stats["total"] = total
     return stats
+
+
+# Materialized-gather traffic: stablehlo.gather (jnp.take / advanced
+# indexing — the decode path's paged_gather walks the whole KV pool
+# through one of these) writes its result tensor to memory and the
+# consumer reads it back, so each gather's HONEST traffic floor is
+# 2x its result bytes ON TOP of the operand reads the arg/output
+# accounting already covers.  dynamic_slice is deliberately excluded:
+# its results are register/VMEM-sized views a fusion almost never
+# materializes, while a gather's data-dependent indices defeat fusion
+# into the consumer on every backend we target.
+_SH_GATHER_RE = re.compile(r"\"?stablehlo\.(?:dynamic_)?gather\"?\b")
+
+
+def stablehlo_gather_stats(stablehlo_text):
+    """``{"count", "bytes"}`` of materialized gather intermediates in
+    LOWERED StableHLO text: ``bytes`` is 2x the summed gather-result
+    bytes (one write, one re-read by the consumer).
+
+    This is what makes :func:`~mxnet_tpu.analysis.cost.program_cost`
+    price the einsum decode path honestly — ``ops.attention.paged_gather``
+    materializes a full (B, M*page_tokens, E) dense-ring view of the KV
+    pool per K and V per layer, the single largest intermediate in the
+    serving system, which pure arg+output accounting cannot see.  The
+    fused Pallas flash-decoding kernel has no such gather (the page walk
+    happens inside the kernel), so the paged decode step's priced bytes
+    visibly drop when ``MXNET_PALLAS_DECODE`` engages — the mfu_table
+    delta the ISSUE-11 acceptance line pins."""
+    count = 0
+    nbytes = 0
+    for line in stablehlo_text.splitlines():
+        if _SH_GATHER_RE.search(line) is None:
+            continue
+        count += 1
+        nbytes += 2 * (_sh_result_bytes(line) or 0)
+    return {"count": count, "bytes": nbytes}
 
 
 def collective_stats(hlo_text):

@@ -289,6 +289,17 @@ class FlopDtypePass(Pass):
     the *lowered StableHLO*, which reflects what was asked for; backend
     legalization (XLA:CPU rewrites bf16 dots through f32) happens later
     and is out of scope.
+
+    Pallas-decode tripwire: a decode/verify artifact built while
+    ``MXNET_PALLAS_DECODE`` was armed carries ``meta['pallas_decode']``
+    — the config PROMISED the fused flash-decoding kernel
+    (``ops/pallas_decode.py``: gather + dequant + attention in one HBM
+    pass).  The promise is checked at the artifact level: the traced
+    jaxpr must contain a ``pallas_call`` (interpret or compiled) or the
+    lowered StableHLO a TPU custom-call.  A program that quietly fell
+    back to the three-pass ``paged_gather`` + einsum path — a shape
+    gate, a dispatch regression — is an *error* here, so the fallback
+    costs a red lint run instead of a silent 3x decode-bandwidth loss.
     """
 
     name = "flop-dtype"
@@ -296,6 +307,24 @@ class FlopDtypePass(Pass):
 
     def run(self, artifact, context):
         findings = []
+        if artifact.meta.get("pallas_decode"):
+            jaxpr = artifact.jaxpr_text or ""
+            shlo = artifact.stablehlo_text or ""
+            if "pallas_call" in jaxpr or "tpu_custom_call" in shlo:
+                findings.append(self.finding(
+                    artifact, "info",
+                    "fused Pallas flash-decoding kernel present "
+                    "(MXNET_PALLAS_DECODE honored)",
+                    code="pallas-decode"))
+            else:
+                findings.append(self.finding(
+                    artifact, "error",
+                    "MXNET_PALLAS_DECODE promises the fused "
+                    "flash-decoding kernel but no pallas_call lowered "
+                    "into this program — decode attention silently fell "
+                    "back to the three-pass paged_gather+einsum path "
+                    "(shape gate or dispatch regression)",
+                    code="pallas-fallback"))
         report = dot_flops_report(artifact.stablehlo_text)
         for rec in report["uncounted_ops"]:
             findings.append(self.finding(

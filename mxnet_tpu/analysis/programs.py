@@ -19,9 +19,13 @@ compiled surfaces behind every headline number so far:
 * ``paged_decode_step`` / ``paged_verify_step`` — the same decode and
   verify programs over SHARED page pools: per-slot page tables and
   active masks ride in as data (zero retraces across admissions, COW
-  forks and retirements), appends scatter through the tables, attention
-  runs over the gathered ring view; their cache-bytes meta is the POOL
-  total (the paged serving HBM bill the cache-bytes pass budgets);
+  forks and retirements), appends scatter through the tables, and
+  attention runs through the FUSED Pallas flash-decoding kernel
+  (``MXNET_PALLAS_DECODE`` armed for the drive; interpret mode off-TPU)
+  — the flop-dtype pass's ``pallas-fallback`` tripwire proves the
+  kernel lowered instead of the three-pass einsum fallback; their
+  cache-bytes meta is the POOL total (the paged serving HBM bill the
+  cache-bytes pass budgets);
 * ``ring_tp_step`` — the attention-LM fused step on the composed
   (data, seq, model) mesh: ring attention with head groups sharded on
   'model' (needs >= 4 devices; the smoke forces the 8-virtual-device
@@ -272,7 +276,7 @@ def _speculative_artifacts():
 
 def _paged_artifacts():
     """paged_decode_step / paged_verify_step, driven by a real
-    shared-prefix paged serve.
+    shared-prefix paged serve WITH THE FUSED KERNEL ON.
 
     Four requests sharing a 6-token prefix drain through a
     :class:`~mxnet_tpu.decode.DecodeServer` over a paged predictor
@@ -280,35 +284,51 @@ def _paged_artifacts():
     hits, a COW-relevant partial-page publish, speculative verify over
     page tables and immediate retirement all run before the artifacts
     snapshot — each program's trace counter must then read exactly one.
+
+    The drive arms ``MXNET_PALLAS_DECODE`` (interpret mode off-TPU), so
+    the canonical paged programs are audited as they SERVE: decode/verify
+    attention through the fused flash-decoding kernel
+    (``ops/pallas_decode.py``), with the flop-dtype pass's
+    ``pallas-fallback`` tripwire proving the kernel actually lowered —
+    a dispatch regression that silently fell back to the einsum path is
+    a red lint run, not a quiet 3x decode-bandwidth loss.
     """
+    from mxnet_tpu import config as _config
     from mxnet_tpu.decode import DecodePredictor, DecodeServer
 
-    d = _LM
-    rng = np.random.RandomState(3)
-    pred = DecodePredictor(
-        _lm_symbol(), _lm_params(_lm_symbol(), d["batch"], d["seq_len"]),
-        cache_len=d["seq_len"], temperature=0.0, kv_dtype="",
-        paged=True, page_tokens=4, prefill_chunk=4)
-    server = DecodeServer(pred, max_prefill=12, slots=d["batch"],
-                          max_new_tokens=3, spec_k=_SPEC_K)
-    prefix = rng.randint(0, d["vocab"], size=(6,))
-    for n in (3, 5, 2, 4):          # shared prefix, mixed tails
-        server.submit(np.concatenate(
-            [prefix, rng.randint(0, d["vocab"], size=(n,))]))
-    results = server.run()
-    stats = server.stats()
-    if len(results) != 4 or server.spec_steps == 0 \
-            or stats.get("prefix_cache_hit_rate", 0) <= 0:
-        raise MXNetError(
-            "paged serve drive did not exercise the paged programs "
-            "(results=%d, spec_steps=%d, hit_rate=%s)"
-            % (len(results), server.spec_steps,
-               stats.get("prefix_cache_hit_rate")))
-    # a fresh batch state at the same sizing lowers the SAME traces
-    state = pred.paged_batch_state(d["batch"])
-    return (pred.decode_artifact(state, name="paged_decode_step"),
-            pred.verify_artifact(state, _SPEC_K,
-                                 name="paged_verify_step"))
+    import jax
+
+    knobs = {"MXNET_PALLAS_DECODE": "1"}
+    if jax.default_backend() != "tpu":
+        knobs["MXNET_PALLAS_INTERPRET"] = "1"
+    with _config.overrides(**knobs):
+        d = _LM
+        rng = np.random.RandomState(3)
+        pred = DecodePredictor(
+            _lm_symbol(), _lm_params(_lm_symbol(), d["batch"],
+                                     d["seq_len"]),
+            cache_len=d["seq_len"], temperature=0.0, kv_dtype="",
+            paged=True, page_tokens=4, prefill_chunk=4)
+        server = DecodeServer(pred, max_prefill=12, slots=d["batch"],
+                              max_new_tokens=3, spec_k=_SPEC_K)
+        prefix = rng.randint(0, d["vocab"], size=(6,))
+        for n in (3, 5, 2, 4):          # shared prefix, mixed tails
+            server.submit(np.concatenate(
+                [prefix, rng.randint(0, d["vocab"], size=(n,))]))
+        results = server.run()
+        stats = server.stats()
+        if len(results) != 4 or server.spec_steps == 0 \
+                or stats.get("prefix_cache_hit_rate", 0) <= 0:
+            raise MXNetError(
+                "paged serve drive did not exercise the paged programs "
+                "(results=%d, spec_steps=%d, hit_rate=%s)"
+                % (len(results), server.spec_steps,
+                   stats.get("prefix_cache_hit_rate")))
+        # a fresh batch state at the same sizing lowers the SAME traces
+        state = pred.paged_batch_state(d["batch"])
+        return (pred.decode_artifact(state, name="paged_decode_step"),
+                pred.verify_artifact(state, _SPEC_K,
+                                     name="paged_verify_step"))
 
 
 def _ckpt_train_step_artifact():

@@ -12,9 +12,11 @@ Variables keep the ``MXNET_`` prefix for reference compatibility.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 
-__all__ = ["EnvVar", "register", "get", "describe", "refresh"]
+__all__ = ["EnvVar", "register", "get", "describe", "refresh",
+           "overrides"]
 
 _REGISTRY = {}
 
@@ -73,6 +75,36 @@ def refresh(name=None):
             var.reset()
 
 
+@contextlib.contextmanager
+def overrides(**knobs):
+    """Temporarily pin declared flags through the environment.
+
+    ``with config.overrides(MXNET_PALLAS_DECODE="1"):`` sets each env
+    var (``None`` unsets it), refreshes the registry cache so the new
+    values are live inside the block, and restores BOTH the environment
+    and the cache on exit — the save/set/refresh/restore dance that
+    benches, the canonical-program drives and tests otherwise each
+    hand-roll.  Values are written with ``str()``; booleans should be
+    passed as "1"/"0" strings to match how the environment spells them.
+    """
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        for k, v in knobs.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        refresh()
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        refresh()
+
+
 def describe():
     """Human-readable catalog of every declared flag (env_var.md analog)."""
     lines = []
@@ -113,6 +145,29 @@ register("MXNET_PALLAS_ATTENTION", bool, False,
          "logits.  Differentiable (custom_vjp backward kernels), so "
          "training takes the flash path too.  Falls back to einsum "
          "otherwise.")
+register("MXNET_PALLAS_DECODE", bool, False,
+         "Use the fused Pallas flash-decoding kernels "
+         "(ops/pallas_decode.py) for decode/verify attention over KV "
+         "caches: the page-table gather, int8/fp8 dequantization and the "
+         "length-masked softmax run in ONE HBM pass over the pool "
+         "(PagedAttention's in-kernel gather), with a split-K grid axis "
+         "parallelizing over cache length (Flash-Decoding) so small-batch "
+         "decode fills the chip.  Applies to paged pools AND dense ring "
+         "buffers (identity page table).  Engages on TPU, or anywhere "
+         "under MXNET_PALLAS_INTERPRET; unsupported shapes (or a "
+         "mesh-sharded cache — Pallas is opaque to GSPMD) fall back to "
+         "the three-pass paged_gather+sdpa_decode einsum path, which the "
+         "mxlint flop-dtype tripwire reports on the canonical paged "
+         "programs so the fallback is never silent.")
+register("MXNET_KV_LAYOUT", str, "",
+         "Device minor-to-major layout requested for decode KV cache "
+         "buffers at allocation, as a comma-separated major_to_minor "
+         "permutation (e.g. '0,1,2' is row-major).  Set from the winning "
+         "row of benchmarks/layout_probe.py --kv, which times decode "
+         "attention under each candidate pool layout on the bench chip.  "
+         "Empty (default) = the backend's native layout.  Backends "
+         "without jax.experimental.layout support (the CPU harness) "
+         "ignore it with a one-time warning.")
 register("MXNET_PALLAS_INTERPRET", bool, False,
          "Run Pallas kernels in interpret mode on non-TPU backends instead "
          "of falling back to einsum (slow; for testing the kernel dispatch "

@@ -423,6 +423,8 @@ class DecodePredictor:
                 else:
                     kc, vc = caches[ci]
                     ci += 1
+                    pos = jnp.asarray(pos0, jnp.int32).reshape(-1)
+                    mesh_on = self._mesh is not None
                     if tables is not None:
                         kc = _attn.paged_append(kc, tables, k, pos0,
                                                 num_heads=heads,
@@ -430,19 +432,25 @@ class DecodePredictor:
                         vc = _attn.paged_append(vc, tables, v, pos0,
                                                 num_heads=heads,
                                                 active=active, valid=valid)
-                        kview = _attn.paged_gather(kc, tables)
-                        vview = _attn.paged_gather(vc, tables)
+                        outs = [_attn.paged_attend(q, kc, vc, tables,
+                                                   pos + t, num_heads=heads,
+                                                   scale=scale,
+                                                   mesh_active=mesh_on)]
                     else:
                         kc = _attn.cache_append(kc, k, pos0,
                                                 num_heads=heads)
                         vc = _attn.cache_append(vc, v, pos0,
                                                 num_heads=heads)
-                        kview, vview = kc, vc
-                    pos = jnp.asarray(pos0, jnp.int32).reshape(-1)
-                    sdpa_cached = _attn.sdpa_decode if t == 1 \
-                        else _attn.sdpa_verify
-                    outs = [sdpa_cached(q, kview, vview, pos + t,
-                                        num_heads=heads, scale=scale)]
+                        outs = [_attn.cache_attend(q, kc, vc, pos + t,
+                                                   num_heads=heads,
+                                                   scale=scale,
+                                                   mesh_active=mesh_on)]
+                    # PATH_TAKEN, recorded at trace time: which decode-
+                    # attention path this predictor's programs actually
+                    # lowered — refines artifact meta so a shape-gated
+                    # fallback ("einsum-gated") never false-trips the
+                    # mxlint pallas-fallback error
+                    self._decode_path = _attn.DECODE_PATH["last"]
                     new_caches.append((kc, vc))
             else:
                 if opname in _POSITION_BROADCAST_OPS and len(ins) == 2 \
@@ -727,8 +735,13 @@ class DecodePredictor:
         (same degrade rule as the dense :meth:`_scale_sharding`)."""
         import jax
 
+        from .ops.attention import apply_kv_layout
+
         if self._mesh is None:
-            return jax.device_put(buf, self._ctx.jax_device)
+            # single-device pools take the probe-chosen device layout
+            # (MXNET_KV_LAYOUT, benchmarks/layout_probe.py --kv); mesh-
+            # sharded pools keep GSPMD's layout choice below
+            return apply_kv_layout(buf, self._ctx.jax_device)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .parallel.tp_rules import kv_pool_pspec
@@ -1264,6 +1277,8 @@ class DecodePredictor:
             for c in (kc, vc):
                 dtypes.add(str((c.data if isinstance(c, QuantKV)
                                 else c).dtype))
+        from .ops.attention import decode_kernel_mode
+
         meta = {"cache_bytes": self.cache_bytes(state),
                 "kv_dtype": str(self._kv_dtype)
                 if self._kv_dtype is not None else None,
@@ -1271,12 +1286,35 @@ class DecodePredictor:
                 "cache_layout": "paged" if self._paged else "dense",
                 "kv_paged": bool(self._paged or (
                     self._paged_from_env
-                    and _config.get("MXNET_KV_PAGED")))}
+                    and _config.get("MXNET_KV_PAGED"))),
+                # the artifact-level PATH_TAKEN tripwire: when the fused
+                # flash-decoding kernel is configured to engage (and no
+                # mesh shards the cache away from it), the flop-dtype
+                # pass demands a pallas_call in the program — a silent
+                # einsum fallback becomes a lint error, not a perf loss.
+                # _refine_pallas_meta withdraws the promise post-trace
+                # when the shape gate VISIBLY refused the kernel
+                # ("einsum-gated" — e.g. head dims off the Mosaic tile
+                # on TPU), so only silent fallbacks trip the error
+                "pallas_decode": bool(decode_kernel_mode()[0]
+                                      and self._mesh is None)}
         if self._paged:
             meta["page_tokens"] = self._page_tokens
             if self._manager is not None:
                 meta["pool_pages"] = self._manager.pool_pages
         return meta
+
+    def _refine_pallas_meta(self, art):
+        """Withdraw the artifact's fused-kernel promise when the dispatch
+        visibly shape-gated it.  ``artifact_from_jit``'s trace (or the
+        serving trace it reuses) just ran ``paged_attend``/
+        ``cache_attend``, which recorded the taken path in
+        ``self._decode_path``; a gated fallback is legitimate — the
+        flop-dtype tripwire targets SILENT einsum regressions only."""
+        if art.meta.get("pallas_decode") and \
+                getattr(self, "_decode_path", None) == "einsum-gated":
+            art.meta["pallas_decode"] = False
+        return art
 
     def decode_artifact(self, state, key=None, name="decode_step"):
         """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
@@ -1300,13 +1338,13 @@ class DecodePredictor:
                 args = (env, astate, _aval(tables), _aval(active), akey)
             else:
                 args = (env, astate, akey)
-            return artifact_from_jit(
+            return self._refine_pallas_meta(artifact_from_jit(
                 self._decode_fn, args, name=name,
                 donated_leaves=donated,
                 mesh_shape=dict(self._mesh.shape)
                 if self._mesh is not None else None,
                 trace_count=count, expected_traces=1,
-                cache_len=self._cache_len, **self._cache_meta(state))
+                cache_len=self._cache_len, **self._cache_meta(state)))
         finally:
             self._probing = False
 
@@ -1343,14 +1381,14 @@ class DecodePredictor:
                         aq, akey)
             else:
                 args = (env, astate, atoks, aq, akey)
-            return artifact_from_jit(
+            return self._refine_pallas_meta(artifact_from_jit(
                 self._verify_fn, args, name=name,
                 donated_leaves=donated,
                 mesh_shape=dict(self._mesh.shape)
                 if self._mesh is not None else None,
                 trace_count=count, expected_traces=expected,
                 cache_len=self._cache_len, spec_k=int(k),
-                **self._cache_meta(state))
+                **self._cache_meta(state)))
         finally:
             self._probing = False
 

@@ -169,6 +169,12 @@ class ProgramAccounting:
                 # programs with explicit exchanges (MoE all-to-all, ring
                 # ppermute) break their wire traffic out of the floor
                 row["collective_bytes"] = cost["collective_bytes"]
+            if cost.get("gather_bytes"):
+                # programs with materialized gather intermediates (the
+                # einsum decode path's paged_gather view of the KV pool)
+                # break them out too — the column the fused Pallas
+                # flash-decoding kernel zeroes
+                row["gather_bytes"] = cost["gather_bytes"]
             if "error" in cost:
                 row["error"] = cost["error"]
             if wall > 0 and calls > 0:
@@ -202,6 +208,8 @@ def render_mfu_table(rows):
             "achieved_tflops", "achieved_gbps", "mfu")
     if any(r.get("collective_bytes") for r in rows):
         cols = cols + ("collective_bytes",)
+    if any(r.get("gather_bytes") for r in rows):
+        cols = cols + ("gather_bytes",)
     table = [[str(c) for c in cols]]
     for r in rows:
         table.append([_fmt(r.get(c)) for c in cols])

@@ -369,6 +369,141 @@ def paged_copy(pool, src, dst):
 # regressing to 100%-einsum (round-3 verdict, Weak #2).
 PATH_TAKEN = {"last": None}
 
+# Same marker for the DECODE-side dispatch (paged_attend / cache_attend):
+# "pallas" when the fused flash-decoding kernel traced, "einsum" for the
+# gather+dequant+attend fallback (knob off or mesh-sharded cache), and
+# "einsum-gated" when the kernel was ARMED but the shape gate
+# (pallas_decode.supported) refused — a legitimate, visible fallback
+# (e.g. head dims off the Mosaic tile on TPU).  mxnet_tpu.decode records
+# it per program so artifact meta promises the kernel only when the
+# dispatch actually took it; the mxlint flop-dtype pass then turns a
+# promised-but-missing pallas_call into a lint error (the artifact-level
+# tripwire), without false-flagging gated shapes.
+DECODE_PATH = {"last": None}
+
+
+def decode_kernel_mode():
+    """``(engage, interpret)`` for the fused decode kernel under the
+    current config and backend: engaged when ``MXNET_PALLAS_DECODE`` is
+    set AND the backend can run it (TPU natively, anything else only
+    under ``MXNET_PALLAS_INTERPRET``)."""
+    from .. import config as _config
+
+    if not _config.get("MXNET_PALLAS_DECODE"):
+        return False, False
+    import jax
+
+    interpret = bool(_config.get("MXNET_PALLAS_INTERPRET"))
+    on_tpu = jax.default_backend() == "tpu"
+    return (on_tpu or interpret), (interpret and not on_tpu)
+
+
+def paged_attend(q, k_pool, v_pool, table, total_len, num_heads=1,
+                 scale=None, mesh_active=False):
+    """Decode/verify attention over shared page pools — the ONE entry the
+    decode programs call.
+
+    With ``MXNET_PALLAS_DECODE`` armed and the shapes supported, this is
+    the fused Pallas flash-decoding kernel
+    (:mod:`~mxnet_tpu.ops.pallas_decode`): the page-table gather, the
+    int8/fp8 dequant and the length-masked softmax run in ONE HBM pass
+    over the pool, split-K parallel over cache length.  Otherwise (knob
+    off, unsupported shape, or a mesh-sharded pool — Pallas is opaque to
+    GSPMD) it falls back to the three-pass einsum path:
+    :func:`paged_gather` + :func:`sdpa_decode`/:func:`sdpa_verify`, whose
+    numerics the kernel matches within documented tolerances
+    (docs/inference.md)."""
+    engage, interp = decode_kernel_mode()
+    if engage and not mesh_active:
+        from . import pallas_decode as _pd
+
+        if _pd.supported(q.shape, k_pool, v_pool, table.shape, num_heads,
+                         interpret=interp):
+            DECODE_PATH["last"] = "pallas"
+            fn = _pd.flash_sdpa_decode if q.shape[1] == 1 \
+                else _pd.flash_sdpa_verify
+            return fn(q, k_pool, v_pool, table, total_len,
+                      num_heads=num_heads, scale=scale, interpret=interp)
+        DECODE_PATH["last"] = "einsum-gated"
+    else:
+        DECODE_PATH["last"] = "einsum"
+    return _sdpa_cache(q, paged_gather(k_pool, table),
+                       paged_gather(v_pool, table), total_len, num_heads,
+                       scale)
+
+
+def cache_attend(q, k_cache, v_cache, total_len, num_heads=1, scale=None,
+                 mesh_active=False):
+    """Decode/verify attention over dense (B, C, E) ring buffers — the
+    non-paged twin of :func:`paged_attend`.  The fused path is the SAME
+    kernel through an identity page table
+    (:func:`~mxnet_tpu.ops.pallas_decode.dense_ring_attend`), so the
+    plain KV-cached serving path gets split-K decode attention too;
+    fallback is :func:`sdpa_decode`/:func:`sdpa_verify` unchanged."""
+    engage, interp = decode_kernel_mode()
+    if engage and not mesh_active:
+        from . import pallas_decode as _pd
+
+        if _pd.supported_dense(q.shape, k_cache, v_cache, num_heads,
+                               interpret=interp):
+            DECODE_PATH["last"] = "pallas"
+            return _pd.dense_ring_attend(q, k_cache, v_cache, total_len,
+                                         num_heads=num_heads, scale=scale,
+                                         interpret=interp)
+        DECODE_PATH["last"] = "einsum-gated"
+    else:
+        DECODE_PATH["last"] = "einsum"
+    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale)
+
+
+_KV_LAYOUT_WARNED = {"done": False}
+
+
+def apply_kv_layout(buf, device=None):
+    """Place a KV cache/pool buffer with the device layout requested by
+    ``MXNET_KV_LAYOUT`` — a comma-separated ``major_to_minor``
+    permutation, set from the winning row of ``benchmarks/layout_probe.py
+    --kv`` (which times decode attention under each candidate pool layout
+    on the bench chip, per the ROADMAP's wire-the-probe clause).
+
+    Empty knob (default) = a plain ``device_put`` to ``device`` (or the
+    buffer as-is when no device is given).  Backends without
+    ``jax.experimental.layout`` support for the request — the CPU harness
+    — fall back to the native layout with a one-time warning, so the knob
+    is safe to leave set in mixed fleets."""
+    import jax
+
+    from .. import config as _config
+
+    spec = str(_config.get("MXNET_KV_LAYOUT")).strip()
+    if not spec:
+        return jax.device_put(buf, device) if device is not None else buf
+    try:
+        order = tuple(int(t) for t in spec.split(","))
+        if sorted(order) != list(range(buf.ndim)):
+            raise ValueError(
+                "MXNET_KV_LAYOUT=%r is not a permutation of 0..%d"
+                % (spec, buf.ndim - 1))
+        from jax.experimental.layout import DeviceLocalLayout, Layout
+        from jax.sharding import SingleDeviceSharding
+
+        dev = device if device is not None else jax.devices()[0]
+        target = Layout(DeviceLocalLayout(major_to_minor=order),
+                        SingleDeviceSharding(dev))
+        out = jax.device_put(buf, target)
+        # some backends accept the API but silently keep their native
+        # layout; that is fine — the request is best-effort by design
+        return out
+    except Exception as exc:
+        if not _KV_LAYOUT_WARNED["done"]:
+            _KV_LAYOUT_WARNED["done"] = True
+            import warnings
+
+            warnings.warn(
+                "MXNET_KV_LAYOUT=%r not applied (%s); KV buffers keep "
+                "the backend's native layout" % (spec, exc))
+        return jax.device_put(buf, device) if device is not None else buf
+
 
 def _attn_shape(attrs, in_shapes, aux_shapes):
     q, k, v = in_shapes

@@ -215,12 +215,30 @@ def test_bench_decode_smoke_contract():
     assert head["serve_paged_tokens_per_sec_per_gb"] > 0, head
     assert head["vs_pr6_per_gb"] > 0, head
 
+    # --- the fused flash-decoding pricing contract ---
+    # all deterministic (static trace+lower pricing, no wall clock): the
+    # einsum decode step's priced attention bytes must exceed the fused
+    # kernel's (the paged_gather view is no longer invisible), and the
+    # active-path field must equal the path the flag names.  The >= 2x
+    # ratio itself is asserted by the bench's own full-dims run (the
+    # pool:view proportions at smoke dims understate the win).
+    assert isinstance(head["pallas_decode_enabled"], bool), head
+    assert head["decode_attn_bytes_per_token_fused"] > 0, head
+    assert head["decode_attn_bytes_per_token_einsum"] > \
+        head["decode_attn_bytes_per_token_fused"], head
+    expect = head["decode_attn_bytes_per_token_fused"] \
+        if head["pallas_decode_enabled"] \
+        else head["decode_attn_bytes_per_token_einsum"]
+    assert head["decode_attn_bytes_per_token"] == expect, head
+    assert head["decode_attn_bytes_ratio"] > 1.0, head
+
     # stderr: one JSON per phase, all phases present
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     phases = {r.get("phase") for r in rows}
     assert {"flops", "prefill", "decode", "naive", "serve",
-            "serve_spec_quant", "serve_paged"} <= phases, phases
+            "serve_spec_quant", "serve_paged", "pallas_decode"} <= phases, \
+        phases
     spec_row = next(r for r in rows if r.get("phase") == "serve_spec_quant")
     dense_row = next(r for r in rows if r.get("phase") == "serve")
     assert spec_row["spec_steps"] > 0
@@ -380,6 +398,14 @@ def test_mxlint_smoke_contract():
                  "verify_step", "paged_decode_step", "paged_verify_step"):
         assert prog in cache_rows, sorted(cache_rows)
     assert cache_rows["decode_step_q"]["detail"]["kv_dtype"] == "int8"
+    # the paged programs were driven WITH the fused flash-decoding
+    # kernel and the flop-dtype tripwire proved it lowered (a silent
+    # einsum fallback would be a 'pallas-fallback' error, not this row)
+    pallas_rows = {r["program"] for r in rows
+                   if r.get("pass") == "flop-dtype"
+                   and r["code"] == "pallas-decode"}
+    assert {"paged_decode_step", "paged_verify_step"} <= pallas_rows, \
+        sorted(pallas_rows)
     assert cache_rows["decode_step_q"]["detail"]["measured"] * 2 <= \
         cache_rows["decode_step"]["detail"]["measured"] * 1.2
     # the paged programs audit POOL bytes (the paged layout recorded)
