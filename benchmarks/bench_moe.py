@@ -176,6 +176,82 @@ def main():
 
     sparse, sparse_mod = measure(cf, "moe_train_step")
     dense, dense_mod = measure(0.0, "moe_dense_train_step")
+
+    # ---- dispatch algorithm accounting (MXNET_MOE_DISPATCH) ----------
+    # price the capacity-slot assignment under BOTH algorithms at this
+    # config's per-group token count: the sort path's argsort/scatter
+    # intermediates vs the one-hot cumsum pack, through the same
+    # program_cost machinery the mfu_table rows use (sort_scatter_bytes
+    # is the column the two modes differ in)
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.analysis.cost import program_cost
+    from mxnet_tpu.ops import moe as _moe
+
+    def _price_dispatch(algo):
+        import jax.numpy as jnp
+
+        # the sparse path's per-group token count shards over BOTH the
+        # data and expert axes (moe.py: n_loc = n // (dp * ep))
+        n_loc = b * t // max(1, cfg.data * ep)
+        cap = _moe._capacity(cf, top_k, n_loc, experts, False)
+        choice = jax.ShapeDtypeStruct((n_loc, top_k), jnp.int32)
+        with _config.overrides(MXNET_MOE_DISPATCH=algo):
+            # fresh closure per mode: jax's trace cache keys on function
+            # identity, and the knob is read at trace time
+            fn = jax.jit(lambda c: _moe._slot_assign(c, experts, cap))
+            return program_cost(fn, (choice,))
+
+    dispatch_cost = {algo: _price_dispatch(algo)
+                     for algo in ("sort", "onehot")}
+    dispatch_mode = str(_config.get("MXNET_MOE_DISPATCH")).lower()
+
+    # ---- sort-vs-onehot token identity (the dispatch contract) -------
+    # one training step of the SAME sparse model under each algorithm on
+    # the composed (data=2, expert=2, model=2) mesh when 8 devices
+    # exist (else this bench's data×expert mesh): outputs AND the
+    # post-update params (≡ grads) must be BIT-identical — the two
+    # algorithms may only differ in what they materialize, never in
+    # which token lands in which slot (drop set included)
+    def _one_step(algo, mesh_cfg, n_ctx):
+        with _config.overrides(MXNET_MOE_DISPATCH=algo):
+            net = attention_lm.get_symbol(
+                vocab_size=vocab, seq_len=t, num_layers=1, embed=e,
+                heads=heads, ffn_hidden=ffn, moe_experts=experts,
+                moe_capacity_factor=cf, moe_top_k=top_k)
+            mod = mx.mod.Module(net, context=[ctx_fn(i)
+                                              for i in range(n_ctx)],
+                                mesh_config=mesh_cfg, compute_dtype=dtype)
+            mod.bind(data_shapes=[DataDesc("data", (b, t), layout="NT")],
+                     label_shapes=[DataDesc("softmax_label", (b, t),
+                                            layout="NT")])
+            mx.random.seed(11)
+            mod.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.01,
+                                                 "momentum": 0.9})
+            batch = DataBatch(
+                [nd.array(x)], [nd.array(y)],
+                provide_data=[DataDesc("data", (b, t), layout="NT")],
+                provide_label=[DataDesc("softmax_label", (b, t),
+                                        layout="NT")])
+            mod.forward_backward(batch)
+            outs = [o.asnumpy() for o in mod.get_outputs()]
+            mod.update()
+            params, _ = mod.get_params()
+            return outs, {n_: v.asnumpy() for n_, v in params.items()}
+
+    if n_dev >= 8 and experts % 2 == 0:
+        id_cfg, id_ctx = MeshConfig(data=2, expert=2, model=2), 8
+    else:
+        id_cfg, id_ctx = cfg, n_dev
+    s_outs, s_params = _one_step("sort", id_cfg, id_ctx)
+    o_outs, o_params = _one_step("onehot", id_cfg, id_ctx)
+    for a, c in zip(s_outs, o_outs):
+        assert np.array_equal(a, c), \
+            "sort dispatch outputs diverge from one-hot"
+    for n_ in s_params:
+        assert np.array_equal(s_params[n_], o_params[n_]), \
+            "sort dispatch grads diverge from one-hot at %s" % n_
     for name, row in (("moe_a2a", sparse), ("dense_dispatch", dense)):
         print(json.dumps({"config": name, "device": kind, "dtype": dtype,
                           "experts": experts, "mesh_expert": ep, "T": t,
@@ -207,6 +283,12 @@ def main():
         all_to_all_bytes=sparse.get("all_to_all_bytes", 0),
         capacity_factor=cf, num_experts_per_tok=top_k,
         experts=experts, mesh_expert=ep,
+        moe_dispatch=dispatch_mode,
+        dispatch_bytes={algo: {"bytes": c["bytes"],
+                               "sort_scatter_bytes":
+                               c["sort_scatter_bytes"]}
+                        for algo, c in dispatch_cost.items()},
+        dispatch_identical=True,
         mfu_table=mfu_rows))
 
     if not SMOKE and ep > 1 and ratio < 2.0:

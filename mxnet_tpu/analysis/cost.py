@@ -41,33 +41,44 @@ def aval_bytes(tree):
 
 
 def program_cost(fn, args):
-    """``{"flops", "bytes", "collective_bytes", "gather_bytes"}`` of a
-    ``jax.jit``-wrapped callable at ``args`` (abstract or concrete): dot
-    FLOPs from one trace→lower, arg+output bytes from the avals,
-    collective wire bytes from the lowered StableHLO's explicit
-    collectives, and materialized-gather intermediate bytes
+    """``{"flops", "bytes", "collective_bytes", "gather_bytes",
+    "sort_scatter_bytes"}`` of a ``jax.jit``-wrapped callable at
+    ``args`` (abstract or concrete): dot FLOPs from one trace→lower,
+    arg+output bytes from the avals, collective wire bytes from the
+    lowered StableHLO's explicit collectives, materialized-gather
+    intermediate bytes
     (:func:`~mxnet_tpu.analysis.hlo_parse.stablehlo_gather_stats`:
-    2x each gather result — one write, one re-read).  The last term is
-    what prices the einsum decode path honestly: ``paged_gather``'s
-    (B, M*page_tokens, E) dense-ring view of the KV pool is the largest
-    intermediate in the serving system and is invisible to arg/output
-    accounting, which understated decode bytes and OVERstated decode MFU
-    until ISSUE-11.  Both extras fold into ``bytes`` and break out
-    separately so the roofline table can show them.  Callers holding
-    trace-counting instrumentation must arm their probing flag around
-    this (the trace here is a probe, same economics as
-    ``artifact_from_jit``)."""
+    2x each gather result — one write, one re-read), and materialized
+    sort/scatter intermediate bytes
+    (:func:`~mxnet_tpu.analysis.hlo_parse.stablehlo_sort_scatter_stats`,
+    same 2x rule).  The gather term is what prices the einsum decode
+    path honestly: ``paged_gather``'s (B, M*page_tokens, E) dense-ring
+    view of the KV pool is the largest intermediate in the serving
+    system and is invisible to arg/output accounting, which understated
+    decode bytes and OVERstated decode MFU until ISSUE-11.  The
+    sort/scatter term does the same for the MoE dispatch algorithms
+    (``MXNET_MOE_DISPATCH``): the sort path's key sort and slot scatter
+    are priced, so the mfu_table compares it honestly against the
+    one-hot cumsum pack it replaced.  All extras fold into ``bytes``
+    and break out separately so the roofline table can show them.
+    Callers holding trace-counting instrumentation must arm their
+    probing flag around this (the trace here is a probe, same economics
+    as ``artifact_from_jit``)."""
     import jax
 
     from .hlo_parse import (dot_flops, stablehlo_collective_stats,
-                            stablehlo_gather_stats)
+                            stablehlo_gather_stats,
+                            stablehlo_sort_scatter_stats)
 
     lowered = fn.trace(*args).lower().as_text()
     flops = dot_flops(lowered)
     coll = stablehlo_collective_stats(lowered)["total"]["bytes"]
     gath = stablehlo_gather_stats(lowered)["bytes"]
+    srtsc = stablehlo_sort_scatter_stats(lowered)["total"]["bytes"]
     out = jax.eval_shape(fn, *args)
     return {"flops": int(flops),
-            "bytes": int(aval_bytes((args, out))) + int(coll) + int(gath),
+            "bytes": int(aval_bytes((args, out))) + int(coll) + int(gath)
+            + int(srtsc),
             "collective_bytes": int(coll),
-            "gather_bytes": int(gath)}
+            "gather_bytes": int(gath),
+            "sort_scatter_bytes": int(srtsc)}

@@ -31,6 +31,7 @@ __all__ = [
     "shape_str",
     "stablehlo_collective_stats",
     "stablehlo_gather_stats",
+    "stablehlo_sort_scatter_stats",
 ]
 
 # Bit widths per HLO/StableHLO element type.  Sub-byte types (s4/u4, the
@@ -566,6 +567,66 @@ def stablehlo_gather_stats(stablehlo_text):
         count += 1
         nbytes += 2 * (_sh_result_bytes(line) or 0)
     return {"count": count, "bytes": nbytes}
+
+
+# Materialized sort/scatter traffic: stablehlo.sort (jnp.argsort /
+# lax.sort — the MoE sort-based dispatch's (expert, priority) key sort)
+# and stablehlo.scatter (jnp .at[].set/add — the capacity-slot pack)
+# write their result tensors to memory and the consumer reads them back,
+# so each op's HONEST traffic floor is 2x its result bytes on top of the
+# operand reads the arg/output accounting covers — the same rule (and
+# reason) as :func:`stablehlo_gather_stats`.  Both ops are REGION-
+# BEARING in the pretty dialect (sort carries a comparator block,
+# scatter an update computation), so their type signature lands on the
+# region's closing ``}) : (...) -> ...`` line, matched with the same
+# pending-queue trick as :func:`stablehlo_collective_stats`.  The op
+# name is matched exactly (``stablehlo.sort`` / ``stablehlo.scatter``),
+# so ``select_and_scatter`` (pooling backward — a windowed op with
+# different materialization behavior) never counts here.
+_SH_SORT_SCATTER_RE = re.compile(r"\"?stablehlo\.(sort|scatter)\"?\b")
+
+
+def stablehlo_sort_scatter_stats(stablehlo_text):
+    """Per-op ``{"count", "bytes"}`` for materialized sort/scatter
+    intermediates in LOWERED StableHLO text, plus a ``"total"`` entry:
+    ``bytes`` is 2x the summed result bytes (one write, one re-read by
+    the consumer; a multi-result sort — argsort's (keys, payload) pair —
+    sums every result tensor).
+
+    This is what lets the roofline table compare the MoE dispatch
+    algorithms honestly (``MXNET_MOE_DISPATCH``): the sort path's
+    intermediates are O(k*N) key/payload vectors plus the slot scatter,
+    where the one-hot cumsum pack materializes (k*N, E) int32 one-hot
+    and cumsum planes — invisible to arg/output accounting, visible
+    here (the cumsum itself lowers to elementwise/reduce-window ops that
+    fuse; the one-hot's cost shows up as the E-times-wider scatter and
+    iota compares priced into the program's other terms, so the
+    comparison floor is conservative for onehot — it can only
+    UNDERSTATE the sort path's win)."""
+    stats = {}
+    pending = []
+
+    def _note(op, nbytes):
+        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += 2 * (nbytes or 0)
+
+    for line in stablehlo_text.splitlines():
+        m = _SH_SORT_SCATTER_RE.search(line)
+        if m is not None:
+            op = m.group(1)
+            nbytes = _sh_result_bytes(line)
+            if nbytes is None:
+                pending.append(op)     # region op: signature comes later
+            else:
+                _note(op, nbytes)
+            continue
+        if pending and line.lstrip().startswith("})") and "->" in line:
+            _note(pending.pop(0), _sh_result_bytes(line))
+    total = {"count": sum(e["count"] for e in stats.values()),
+             "bytes": sum(e["bytes"] for e in stats.values())}
+    stats["total"] = total
+    return stats
 
 
 def collective_stats(hlo_text):

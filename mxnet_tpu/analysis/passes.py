@@ -305,26 +305,36 @@ class FlopDtypePass(Pass):
     name = "flop-dtype"
     requires = ("stablehlo",)
 
+    _PALLAS_PROMISES = (
+        ("pallas_decode", "MXNET_PALLAS_DECODE", "pallas-decode",
+         "fused Pallas flash-decoding kernel present "
+         "(MXNET_PALLAS_DECODE honored)",
+         "MXNET_PALLAS_DECODE promises the fused flash-decoding kernel "
+         "but no pallas_call lowered into this program — decode "
+         "attention silently fell back to the three-pass "
+         "paged_gather+einsum path (shape gate or dispatch regression)"),
+        ("pallas_update", "MXNET_PALLAS_UPDATE", "pallas-update",
+         "fused multi-tensor Pallas optimizer-update kernel present "
+         "(MXNET_PALLAS_UPDATE honored)",
+         "MXNET_PALLAS_UPDATE promises the fused multi-tensor "
+         "optimizer-update kernel but no pallas_call lowered into this "
+         "program — the update silently fell back to the per-parameter "
+         "XLA chain (plan gate or dispatch regression)"),
+    )
+
     def run(self, artifact, context):
         findings = []
-        if artifact.meta.get("pallas_decode"):
+        for key, _knob, ok_code, ok_msg, fail_msg in self._PALLAS_PROMISES:
+            if not artifact.meta.get(key):
+                continue
             jaxpr = artifact.jaxpr_text or ""
             shlo = artifact.stablehlo_text or ""
             if "pallas_call" in jaxpr or "tpu_custom_call" in shlo:
                 findings.append(self.finding(
-                    artifact, "info",
-                    "fused Pallas flash-decoding kernel present "
-                    "(MXNET_PALLAS_DECODE honored)",
-                    code="pallas-decode"))
+                    artifact, "info", ok_msg, code=ok_code))
             else:
                 findings.append(self.finding(
-                    artifact, "error",
-                    "MXNET_PALLAS_DECODE promises the fused "
-                    "flash-decoding kernel but no pallas_call lowered "
-                    "into this program — decode attention silently fell "
-                    "back to the three-pass paged_gather+einsum path "
-                    "(shape gate or dispatch regression)",
-                    code="pallas-fallback"))
+                    artifact, "error", fail_msg, code="pallas-fallback"))
         report = dot_flops_report(artifact.stablehlo_text)
         for rec in report["uncounted_ops"]:
             findings.append(self.finding(

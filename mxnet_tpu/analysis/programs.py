@@ -413,7 +413,7 @@ def _moe_train_step_artifact():
     to the GSPMD-hint path would let the collective budget drift
     meaninglessly — so the MOE_PATH tripwire is checked before the
     artifact snapshots."""
-    from mxnet_tpu.ops.moe import MOE_PATH
+    from mxnet_tpu.ops.moe import MOE_DISPATCH, MOE_PATH
 
     import jax
 
@@ -426,6 +426,11 @@ def _moe_train_step_artifact():
             "MoE fused step did not take the explicit all-to-all "
             "dispatch (MOE_PATH=%r); the moe_train_step budget would "
             "not cover the exchange" % (MOE_PATH["last"],))
+    if MOE_DISPATCH["last"] != "sort":
+        raise MXNetError(
+            "MoE capacity dispatch did not take the default sort-based "
+            "algorithm (MOE_DISPATCH=%r); the moe_train_step budget "
+            "would price the wrong pack" % (MOE_DISPATCH["last"],))
     return step.artifact(name="moe_train_step")
 
 
@@ -447,14 +452,32 @@ def build_canonical_artifacts(names=None):
     artifacts, notes = [], {}
 
     if "train_step" in want or "eval_step" in want:
-        mod, batch = _mlp_module()
-        if "train_step" in want:
-            # the eval program needs only the bound group; driving (and
-            # compiling) the fused step is the train artifact's cost
-            step = _drive_fused(mod, batch)
-            artifacts.append(step.artifact(name="train_step"))
-        if "eval_step" in want:
-            artifacts.append(_eval_artifact(mod, batch))
+        # the canonical train_step is audited WITH the fused multi-tensor
+        # Pallas optimizer update armed (interpret off-TPU), so the
+        # flop-dtype pass's pallas-fallback tripwire proves the kernel
+        # lowered — the same arming story as the paged decode programs
+        from .. import config as _config
+
+        import jax as _jax
+
+        knobs = {"MXNET_PALLAS_UPDATE": "1"}
+        if _jax.default_backend() != "tpu":
+            knobs["MXNET_PALLAS_INTERPRET"] = "1"
+        with _config.overrides(**knobs):
+            mod, batch = _mlp_module()
+            if "train_step" in want:
+                # the eval program needs only the bound group; driving
+                # (and compiling) the fused step is the train artifact's
+                # cost
+                step = _drive_fused(mod, batch)
+                if step._plan is None:
+                    raise MXNetError(
+                        "MXNET_PALLAS_UPDATE armed but the canonical "
+                        "MLP step built no update plan (SGD-momentum "
+                        "f32 masters must be in scope)")
+                artifacts.append(step.artifact(name="train_step"))
+            if "eval_step" in want:
+                artifacts.append(_eval_artifact(mod, batch))
 
     if "prefill" in want or "decode_step" in want:
         prefill, decode = _decode_artifacts()

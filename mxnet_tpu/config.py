@@ -159,6 +159,34 @@ register("MXNET_PALLAS_DECODE", bool, False,
          "the three-pass paged_gather+sdpa_decode einsum path, which the "
          "mxlint flop-dtype tripwire reports on the canonical paged "
          "programs so the fallback is never silent.")
+register("MXNET_PALLAS_UPDATE", bool, False,
+         "Use the fused multi-tensor Pallas optimizer-update kernel "
+         "(ops/pallas_update.py) inside the compiled train step: the "
+         "donated param/grad/slot trees flatten into dtype-homogeneous "
+         "flat slabs (multi-tensor apply) and ONE Pallas pass per slab "
+         "does grad rescale + clip + bf16->f32 promotion + the "
+         "SGD-momentum/Adam moment update (at the true update count t) "
+         "+ the compute-dtype recast — replacing the per-parameter XLA "
+         "update fusions, whose cast/rescale/clip/update/recast chain "
+         "round-trips every param, grad and slot through HBM "
+         "separately.  Engages on TPU, or anywhere under "
+         "MXNET_PALLAS_INTERPRET; unsupported optimizers (anything but "
+         "SGD/Adam), non-float32/bfloat16 params, mesh-sharded masters "
+         "and the eager opt_owner fall back to the existing per-param "
+         "path unchanged (the mxlint flop-dtype pass's pallas-fallback "
+         "tripwire covers the promise on canonical programs).")
+register("MXNET_MOE_DISPATCH", str, "sort",
+         "Capacity-slot assignment algorithm for the sparse MoE "
+         "dispatch (ops/moe.py): 'sort' (default) ranks the (token, "
+         "rank-k choice) pairs by argsort over a composite "
+         "(expert, priority) key and derives each choice's capacity "
+         "position from its index within the sorted expert group "
+         "(MegaBlocks-style sort/scatter dispatch — no (N*k, E) one-hot "
+         "cumsum ever materializes); 'onehot' restores the one-hot "
+         "cumsum pack for A/B comparison.  Both produce BIT-IDENTICAL "
+         "slot assignments, outputs, grads and drop sets (tier-1 "
+         "asserted); the dispatch intermediates they materialize "
+         "differ, priced by analysis/cost.py sort/scatter accounting.")
 register("MXNET_KV_LAYOUT", str, "",
          "Device minor-to-major layout requested for decode KV cache "
          "buffers at allocation, as a comma-separated major_to_minor "

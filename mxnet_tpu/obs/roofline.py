@@ -175,6 +175,19 @@ class ProgramAccounting:
                 # break them out too — the column the fused Pallas
                 # flash-decoding kernel zeroes
                 row["gather_bytes"] = cost["gather_bytes"]
+            if cost.get("sort_scatter_bytes"):
+                # programs with materialized sort/scatter intermediates
+                # (the MoE sort-based dispatch's key sort + slot
+                # scatter) — the column that prices the two
+                # MXNET_MOE_DISPATCH algorithms against each other
+                row["sort_scatter_bytes"] = cost["sort_scatter_bytes"]
+            if cost.get("update_path"):
+                # the opt_update row: which update path is armed, plus
+                # both paths' priced bytes so the fused-vs-per-param
+                # comparison travels with the table
+                for k in ("update_path", "per_param_bytes",
+                          "fused_bytes"):
+                    row[k] = cost.get(k)
             if "error" in cost:
                 row["error"] = cost["error"]
             if wall > 0 and calls > 0:
@@ -210,6 +223,8 @@ def render_mfu_table(rows):
         cols = cols + ("collective_bytes",)
     if any(r.get("gather_bytes") for r in rows):
         cols = cols + ("gather_bytes",)
+    if any(r.get("sort_scatter_bytes") for r in rows):
+        cols = cols + ("sort_scatter_bytes",)
     table = [[str(c) for c in cols]]
     for r in rows:
         table.append([_fmt(r.get(c)) for c in cols])

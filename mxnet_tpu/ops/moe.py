@@ -58,6 +58,11 @@ from ..registry import OpDef, register_op
 # ops.attention.PATH_TAKEN / parallel.ring.RING_PATH
 MOE_PATH = {"last": None}
 
+# which capacity-slot assignment algorithm the last sparse trace used
+# ("sort" | "onehot") — the MXNET_MOE_DISPATCH tripwire; None until a
+# capacity path traces
+MOE_DISPATCH = {"last": None}
+
 
 def _moe_shape(attrs, in_shapes, aux_shapes):
     x, wg, w1, b1, w2, b2 = in_shapes
@@ -92,6 +97,46 @@ def _route(probs, k):
     return choice, gate
 
 
+def _positions_onehot(choice, e):
+    """Capacity positions via the one-hot cumsum pack (the historical
+    algorithm, kept for A/B pricing): materializes a (k*n, E) int32
+    one-hot and its running cumsum — E x the index traffic of the sort
+    path.  Counting runs in int32: an activation-dtype cumsum loses
+    exact integers past 256 and would silently collide slots on big
+    batches."""
+    import jax
+    import jax.numpy as jnp
+
+    n, k = choice.shape
+    oh = jax.nn.one_hot(choice, e, dtype=jnp.int32)        # (n, k, E)
+    oh_rank_major = oh.transpose(1, 0, 2).reshape(k * n, e)
+    return ((jnp.cumsum(oh_rank_major, axis=0) - 1) * oh_rank_major) \
+        .sum(-1).reshape(k, n).T                           # (n, k)
+
+
+def _positions_sort(choice, e):
+    """Capacity positions via sort-based dispatch (MegaBlocks, Gale et
+    al. 2022): a STABLE argsort of the rank-major flattened choices is
+    exactly an argsort over the composite (expert, priority) key — same-
+    expert entries keep rank-major order — so each entry's position
+    within its expert group is its sorted index minus the group start
+    (an exclusive cumsum of the per-expert histogram).  No (k*n, E)
+    one-hot ever materializes: the intermediates are O(k*n) sort keys
+    and one length-E histogram, priced by the analysis sort/scatter
+    accounting."""
+    import jax.numpy as jnp
+
+    n, k = choice.shape
+    flat = choice.transpose(1, 0).reshape(-1)              # rank-major (k*n,)
+    order = jnp.argsort(flat, stable=True)
+    counts = jnp.bincount(flat, length=e).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts                   # exclusive
+    pos_sorted = jnp.arange(k * n, dtype=jnp.int32) \
+        - starts[jnp.take(flat, order)]
+    return jnp.zeros((k * n,), jnp.int32).at[order].set(pos_sorted) \
+        .reshape(k, n).T                                   # (n, k)
+
+
 def _slot_assign(choice, e, cap):
     """Capacity-slot assignment for one token group.
 
@@ -99,17 +144,27 @@ def _slot_assign(choice, e, cap):
     before any rank-1 choice (GShard order — a token's second expert can
     never evict another token's first).  Returns ``(pos, keep, slot)``,
     all (n, k); ``slot = choice*cap + pos`` clipped into [0, e*cap).
-    Counting runs in int32: an activation-dtype cumsum loses exact
-    integers past 256 and would silently collide slots on big batches.
+
+    ``MXNET_MOE_DISPATCH`` selects the position algorithm at trace time:
+    'sort' (default — argsort over the composite (expert, priority) key)
+    or 'onehot' (the one-hot cumsum pack).  Both are BIT-IDENTICAL in
+    (pos, keep, slot) — and therefore in outputs, grads and drop sets —
+    differing only in the dispatch intermediates they materialize
+    (tier-1 asserts the identity; the sparse reference and the sharded
+    all-to-all path share this one implementation so the knob can never
+    split them).
     """
-    import jax
     import jax.numpy as jnp
 
-    n, k = choice.shape
-    oh = jax.nn.one_hot(choice, e, dtype=jnp.int32)        # (n, k, E)
-    oh_rank_major = oh.transpose(1, 0, 2).reshape(k * n, e)
-    pos = ((jnp.cumsum(oh_rank_major, axis=0) - 1) * oh_rank_major) \
-        .sum(-1).reshape(k, n).T                           # (n, k)
+    from .. import config as _config
+
+    algo = (str(_config.get("MXNET_MOE_DISPATCH")) or "sort").lower()
+    if algo not in ("sort", "onehot"):
+        raise ValueError("MXNET_MOE_DISPATCH must be 'sort' or 'onehot'; "
+                         "got %r" % algo)
+    MOE_DISPATCH["last"] = algo
+    pos = (_positions_sort if algo == "sort"
+           else _positions_onehot)(choice, e)
     keep = pos < cap
     slot = choice * cap + jnp.minimum(pos, cap - 1)
     return pos, keep, slot

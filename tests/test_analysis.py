@@ -529,3 +529,70 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+# ---------------------------------------------------------------------------
+# sort/scatter intermediate pricing (stablehlo_sort_scatter_stats)
+# ---------------------------------------------------------------------------
+def test_sort_scatter_stats_canned_snippets():
+    """Canned lowered-StableHLO forms: a region-bearing multi-result
+    sort (argsort's (keys, payload) pair), a region-bearing scatter,
+    an inline one-line sort — and select_and_scatter (pooling backward)
+    must NOT count."""
+    from mxnet_tpu.analysis.hlo_parse import stablehlo_sort_scatter_stats
+
+    text = "\n".join([
+        'module @jit_f {',
+        '  %0:2 = "stablehlo.sort"(%arg0, %arg1) ({',
+        '  ^bb0(%a: tensor<i32>, %b: tensor<i32>, %c: tensor<i32>,'
+        ' %d: tensor<i32>):',
+        '    %c0 = stablehlo.compare  LT, %a, %b : (tensor<i32>,'
+        ' tensor<i32>) -> tensor<i1>',
+        '    stablehlo.return %c0 : tensor<i1>',
+        '  }) : (tensor<64xi32>, tensor<64xi32>)'
+        ' -> (tensor<64xi32>, tensor<64xi32>)',
+        '  %1 = "stablehlo.scatter"(%arg2, %idx, %upd) ({',
+        '  ^bb0(%e: tensor<f32>, %f: tensor<f32>):',
+        '    stablehlo.return %f : tensor<f32>',
+        '  }) : (tensor<16xf32>, tensor<4x1xi32>, tensor<4xf32>)'
+        ' -> tensor<16xf32>',
+        '  %2 = "stablehlo.select_and_scatter"(%x, %y, %z) ({',
+        '  ^bb0(%g: tensor<f32>, %h: tensor<f32>):',
+        '    stablehlo.return %g : tensor<i1>',
+        '  }) : (tensor<8x8xf32>, tensor<4x4xf32>, tensor<f32>)'
+        ' -> tensor<8x8xf32>',
+        '  %3 = "stablehlo.sort"(%arg3) : (tensor<32xbf16>)'
+        ' -> tensor<32xbf16>',
+        '}',
+    ])
+    stats = stablehlo_sort_scatter_stats(text)
+    # region sort: 2x (64*4 + 64*4); inline sort: 2x 32*2
+    assert stats["sort"] == {"count": 2, "bytes": 2 * 512 + 2 * 64}
+    # scatter: 2x the 16-f32 result; select_and_scatter NOT counted
+    assert stats["scatter"] == {"count": 1, "bytes": 2 * 64}
+    assert stats["total"] == {"count": 3,
+                              "bytes": 2 * 512 + 2 * 64 + 2 * 64}
+
+
+def test_sort_scatter_stats_empty_and_real_lowering():
+    """No sort/scatter -> zero totals; and a REAL jax argsort+scatter
+    lowering is priced > 0 through program_cost (the sort_scatter_bytes
+    term folds into bytes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.analysis.cost import program_cost
+    from mxnet_tpu.analysis.hlo_parse import stablehlo_sort_scatter_stats
+
+    assert stablehlo_sort_scatter_stats("module @empty {}")["total"] == \
+        {"count": 0, "bytes": 0}
+
+    def f(x):
+        order = jnp.argsort(x)
+        return jnp.zeros_like(x).at[order].set(x)
+
+    spec = jax.ShapeDtypeStruct((128,), jnp.float32)
+    cost = program_cost(jax.jit(f), (spec,))
+    assert cost["sort_scatter_bytes"] > 0
+    # the term folds into the total bytes floor
+    assert cost["bytes"] >= 2 * 128 * 4 + cost["sort_scatter_bytes"]

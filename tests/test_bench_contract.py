@@ -106,6 +106,18 @@ def test_bench_smoke_async_loop_contract():
         assert row["mfu"] is None or 0 < row["mfu"] <= 1, row
     # the fit dominates: train_step saw every step the loop dispatched
     assert rows["train_step"]["calls"] >= 50, rows["train_step"]
+    # ... plus the optimizer-phase HBM pricing (ISSUE-12): both update
+    # paths' priced bytes ride the contract (the ≤ 0.5x fused ratio is
+    # asserted by the non-smoke headline at ResNet sizes, where the
+    # per-param block padding is negligible), and the opt_update
+    # roofline row publishes whichever path is armed
+    ob = head["opt_update_bytes"]
+    assert ob["per_param_bytes"] > 0 and ob["fused_bytes"] > 0, ob
+    assert ob["path"] in ("pallas", "xla"), ob
+    assert set(ob["phases"]) >= {"rescale", "clip", "update"}, ob
+    assert "opt_update" in rows, sorted(rows)
+    assert rows["opt_update"]["bytes"] == ob[
+        "fused_bytes" if ob["path"] == "pallas" else "per_param_bytes"]
 
 
 def test_bench_long_context_smoke_contract():
@@ -302,6 +314,17 @@ def test_bench_moe_smoke_contract():
     assert mfu["moe_train_step"]["collective_bytes"] > 0, mfu
     assert mfu["moe_train_step"]["flops"] * 2 <= \
         mfu["moe_dense_train_step"]["flops"], mfu
+    # ... plus the dispatch-algorithm accounting (ISSUE-12): the default
+    # is the sort-based pack, both algorithms' priced dispatch bytes are
+    # published (only the sort path materializes sort/scatter
+    # intermediates), and the bench itself asserted token identity
+    # across algorithms before emitting the line
+    assert head["moe_dispatch"] == "sort", head
+    db = head["dispatch_bytes"]
+    assert db["sort"]["sort_scatter_bytes"] > 0, db
+    assert db["onehot"]["sort_scatter_bytes"] == 0, db
+    assert db["sort"]["bytes"] != db["onehot"]["bytes"], db
+    assert head["dispatch_identical"] is True, head
 
 
 def test_mxstat_smoke_contract():

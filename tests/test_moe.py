@@ -458,3 +458,144 @@ def test_moe_sparse_expert_parallel_all_to_all():
     modN.backward()
     st = collective_stats(modN._exec_group.exec_.compiled_hlo())
     assert st.get("all-to-all", {"count": 0})["count"] > 0, st
+
+
+# ---------------------------------------------------------------------------
+# dispatch algorithm (MXNET_MOE_DISPATCH): sort-based vs one-hot cumsum
+# ---------------------------------------------------------------------------
+def _slot_assign_both(choice, e, cap):
+    """(pos, keep, slot) under each dispatch algorithm, with the
+    MOE_DISPATCH tripwire checked per trace.  Fresh jit closures per
+    mode: the knob is read at TRACE time, and jax's cache would
+    otherwise hand back the first mode's program."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import config
+    from mxnet_tpu.ops.moe import MOE_DISPATCH, _slot_assign
+
+    out = {}
+    for algo in ("sort", "onehot"):
+        with config.overrides(MXNET_MOE_DISPATCH=algo):
+            MOE_DISPATCH["last"] = None
+            fn = jax.jit(lambda c: _slot_assign(c, e, cap))
+            out[algo] = tuple(np.asarray(v)
+                              for v in fn(jnp.asarray(choice)))
+            assert MOE_DISPATCH["last"] == algo, MOE_DISPATCH
+    return out["sort"], out["onehot"]
+
+
+@pytest.mark.parametrize("n,k,e,cap", [(64, 2, 4, 9), (33, 1, 8, 3),
+                                       (128, 4, 2, 70), (16, 2, 4, 1)])
+def test_moe_dispatch_sort_equals_onehot(n, k, e, cap):
+    """The dispatch contract: both algorithms produce BIT-identical
+    (pos, keep, slot) for the same routing — including overflow (the
+    drop set is `pos >= cap`), rank-priority ties (every rank-0 choice
+    outranks every rank-1) and single-expert pile-ups."""
+    rng = np.random.RandomState(n + k)
+    choice = rng.randint(0, e, size=(n, k)).astype(np.int32)
+    s, o = _slot_assign_both(choice, e, cap)
+    for name, a, b in zip(("pos", "keep", "slot"), s, o):
+        assert np.array_equal(a, b), name
+    # GShard rank-major priority really holds in the shared result:
+    # among same-expert choices, every rank-0 position precedes rank-1
+    pos, keep, _ = s
+    if k > 1:
+        for ex in range(e):
+            r0 = pos[:, 0][choice[:, 0] == ex]
+            r1 = pos[:, 1][choice[:, 1] == ex]
+            if len(r0) and len(r1):
+                assert r0.max(initial=-1) < len(r0), ex
+                assert (r1 >= len(r0)).all(), ex
+
+
+def test_moe_dispatch_one_expert_takes_all():
+    """Degenerate routing (every token to expert 0) keeps positions
+    dense 0..n-1 under both algorithms."""
+    choice = np.zeros((24, 1), np.int32)
+    s, o = _slot_assign_both(choice, 4, 30)
+    assert np.array_equal(s[0][:, 0], np.arange(24))
+    assert np.array_equal(s[0], o[0])
+
+
+def test_moe_dispatch_invalid_knob_raises():
+    import jax.numpy as jnp
+
+    from mxnet_tpu import config
+    from mxnet_tpu.ops.moe import _slot_assign
+
+    with config.overrides(MXNET_MOE_DISPATCH="radix"):
+        with pytest.raises(ValueError, match="MXNET_MOE_DISPATCH"):
+            _slot_assign(jnp.zeros((4, 1), jnp.int32), 2, 2)
+
+
+def test_moe_sparse_outputs_grads_identical_across_dispatch():
+    """One training-shaped fwd+bwd of the sparse MoE module under each
+    dispatch algorithm: outputs, input grads and weight grads must be
+    BIT-identical (the algorithms may differ only in what they
+    materialize, never in which token lands in which slot)."""
+    from mxnet_tpu import config
+
+    rng = np.random.RandomState(23)
+    # cf tight enough that some token loses BOTH experts (all-zero row:
+    # the drop set must be visible, or the identity check is vacuous)
+    n, d, e, h, k, cf = 48, 8, 4, 12, 2, 0.2
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    wg, w1, b1, w2, b2 = _weights(rng, d, e, h)
+
+    def run(algo):
+        with config.overrides(MXNET_MOE_DISPATCH=algo):
+            s = sym.MoEFFN(sym.Variable("data"), num_experts=e,
+                           hidden_size=h, capacity_factor=cf,
+                           num_experts_per_tok=k, aux_loss_coeff=0.3,
+                           name="moe")
+            mod = mx.mod.Module(s, context=mx.cpu(0))
+            mod.bind(data_shapes=[("data", (n, d))], for_training=True,
+                     inputs_need_grad=True)
+            mod.init_params(arg_params={
+                "moe_gate_weight": nd.array(wg),
+                "moe_expert1_weight": nd.array(w1),
+                "moe_expert1_bias": nd.array(b1),
+                "moe_expert2_weight": nd.array(w2),
+                "moe_expert2_bias": nd.array(b2)})
+            mod.forward(DataBatch([nd.array(x)], []), is_train=True)
+            y = mod.get_outputs()[0].asnumpy()
+            mod.backward(out_grads=[nd.ones((n, d))])
+            grads = {nm: ga.asnumpy() for nm, ga in
+                     zip(mod._exec_group.param_names,
+                         mod._exec_group.grad_arrays) if ga is not None}
+            return y, mod.get_input_grads()[0].asnumpy(), grads
+
+    ys, dxs, gs = run("sort")
+    yo, dxo, go = run("onehot")
+    drop = (ys == 0).all(-1)
+    assert drop.sum() > 0, "capacity never bound; identity is vacuous"
+    assert np.array_equal(ys, yo), "outputs diverge"
+    assert np.array_equal(dxs, dxo), "input grads diverge"
+    for nm in gs:
+        assert np.array_equal(gs[nm], go[nm]), nm
+
+
+def test_moe_dispatch_sort_prices_differently():
+    """The two algorithms must NOT price identically: the sort path
+    carries stablehlo.sort/scatter intermediates the analysis
+    accounting now prices (hlo_parse.stablehlo_sort_scatter_stats);
+    the one-hot pack has none of either."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import config
+    from mxnet_tpu.analysis.cost import program_cost
+    from mxnet_tpu.ops.moe import _slot_assign
+
+    choice = jax.ShapeDtypeStruct((64, 2), jnp.int32)
+
+    def price(algo):
+        with config.overrides(MXNET_MOE_DISPATCH=algo):
+            fn = jax.jit(lambda c: _slot_assign(c, 4, 9))
+            return program_cost(fn, (choice,))
+
+    s, o = price("sort"), price("onehot")
+    assert s["sort_scatter_bytes"] > 0, s
+    assert o["sort_scatter_bytes"] == 0, o
+    assert s["bytes"] != o["bytes"]

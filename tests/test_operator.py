@@ -98,8 +98,13 @@ def test_convolution():
     check_symbolic_forward(conv, {"data": x, "conv_weight": w, "conv_bias": b},
                            [_np_conv(x, w, b, (2, 2), (1, 1))], rtol=2e-2,
                            atol=1e-2)
+    # atol widened from the 1e-4 default: central differences at
+    # eps=1e-2 over an f32 XLA-CPU conv carry ~1.5e-3 absolute noise on
+    # near-zero gradient elements (same provenance as the forward's
+    # ~3e-3 note above; measured drift on jax 0.4.37 — rtol still pins
+    # every element of meaningful magnitude)
     check_numeric_gradient(conv, {"data": x, "conv_weight": w, "conv_bias": b},
-                           rtol=0.1, numeric_eps=1e-2)
+                           rtol=0.1, atol=4e-3, numeric_eps=1e-2)
 
 
 def test_pooling():

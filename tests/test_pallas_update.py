@@ -1,0 +1,449 @@
+"""Fused multi-tensor Pallas optimizer update (MXNET_PALLAS_UPDATE).
+
+The training-step HBM diet's parameter-update half (ISSUE-12): the
+donated param/grad/slot trees flatten into dtype-homogeneous slabs and
+ONE Pallas pass per slab runs the whole rescale+clip+promote+update+
+recast chain (ops/pallas_update.py).  The contract these tests pin:
+
+* numerics — SGD-momentum BIT-identical to the per-parameter XLA path,
+  Adam tolerance-documented at <= 1e-6 f32 (docs/performance.md), over
+  f32 and bf16-compute trees, fixed (no-grad) params included;
+* lifecycle — kill-and-resume under async fenced checkpointing stays
+  bit-identical with the kernel armed (the persistent compute slabs are
+  a pure cast(master) cache, reseeded on every out-of-chain restore);
+* fallback matrix — unsupported optimizers/dtypes/meshes fall back to
+  the per-parameter path (UPDATE_PATH tripwire), and a stamped artifact
+  whose pallas_call vanished is a RED mxlint run (pallas-fallback);
+* pricing — the fused path's priced optimizer-phase HBM bytes are
+  <= 0.5x the per-parameter chain's at the headline (bf16 SGD-momentum)
+  config.
+"""
+import dataclasses
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.ops import pallas_update
+
+
+def _armed(**extra):
+    return config.overrides(MXNET_PALLAS_UPDATE="1",
+                            MXNET_PALLAS_INTERPRET="1", **extra)
+
+
+def _make_module(optimizer, compute_dtype=None, fixed=None, seed=7):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=4)
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype=compute_dtype,
+                        fixed_param_names=fixed)
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mx.random.seed(seed)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    os.environ["MXNET_FUSED_TRAIN_STEP"] = "1"
+    config.refresh("MXNET_FUSED_TRAIN_STEP")
+    mod.init_optimizer(optimizer=optimizer,
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9, "wd": 1e-4}
+                       if optimizer in ("sgd", "nag")
+                       else {"learning_rate": 0.01})
+    return mod
+
+
+def _batches(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [DataBatch([nd.array(rng.uniform(-1, 1, (8, 10))
+                                .astype(np.float32))],
+                      [nd.array(rng.randint(0, 4, (8,))
+                                .astype(np.float32))])
+            for _ in range(n)]
+
+
+def _train(mod, batches):
+    for b in batches:
+        mod.forward_backward(b)
+        mod.update()
+    params, _ = mod.get_params()
+    return {n: v.asnumpy() for n, v in params.items()}
+
+
+# ---------------------------------------------------------------------------
+# parity with the per-parameter XLA path
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+@pytest.mark.parametrize("cdtype", [None, "bfloat16"])
+def test_fused_update_parity(optimizer, cdtype):
+    """SGD-momentum is BIT-identical to the per-parameter XLA chain;
+    Adam within the documented 1e-6 f32 tolerance — on both the pure-f32
+    and the bf16-compute (persistent compute slab) configurations."""
+    batches = _batches(5)
+    ref = _train(_make_module(optimizer, cdtype), batches)
+    with _armed():
+        mod = _make_module(optimizer, cdtype)
+        assert mod._fused_step._plan is not None
+        assert pallas_update.UPDATE_PATH["last"] == "pallas"
+        got = _train(mod, batches)
+    for name in ref:
+        if optimizer == "sgd":
+            assert np.array_equal(ref[name], got[name]), name
+        else:
+            np.testing.assert_allclose(got[name], ref[name], rtol=0,
+                                       atol=1e-6, err_msg=name)
+
+
+def test_fused_update_parity_with_fixed_params():
+    """Fixed (no-grad) params stay outside the plan — cast per step like
+    any constant — and the trained params still match bit-exactly."""
+    batches = _batches(4)
+    ref = _train(_make_module("sgd", "bfloat16", fixed=["fc1_bias"]),
+                 batches)
+    with _armed():
+        mod = _make_module("sgd", "bfloat16", fixed=["fc1_bias"])
+        plan = mod._fused_step._plan
+        assert plan is not None
+        planned = {s.name for segs in plan.buckets.values() for s in segs}
+        assert "fc1_bias" not in planned
+        got = _train(mod, batches)
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), name
+
+
+def test_wcast_reseeds_on_set_params():
+    """Masters replaced from OUTSIDE the step chain (set_params) must
+    refresh the persistent compute slabs: the next armed step then
+    matches the per-parameter path run from the same new masters."""
+    batches = _batches(3)
+
+    def sequence(armed):
+        mod = _make_module("sgd", "bfloat16")
+        _train(mod, batches[:2])
+        donor = _make_module("sgd", "bfloat16", seed=99)
+        new_args, new_aux = donor.get_params()
+        mod.set_params(new_args, new_aux)  # slots carry over, by design
+        if armed:
+            assert mod._fused_step._plan is not None
+        return _train(mod, batches[2:])
+
+    with _armed():
+        got = sequence(True)
+    ref = sequence(False)
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), name
+
+
+# ---------------------------------------------------------------------------
+# mixed bf16/f32 master trees (synthetic slab-level parity)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind,nslots", [("sgd", 1), ("sgd", 0),
+                                         ("adam", 2)])
+def test_mixed_dtype_tree_slab_parity(kind, nslots):
+    """plan.apply over a MIXED bf16/f32 master tree (awkward shapes:
+    sub-block, multi-block, scalar) matches the per-parameter reference
+    math with store-dtype semantics — SGD bit-exact, Adam <= 1e-6."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    shapes = {"w_a": ((33, 7), np.float32), "w_b": ((4096,), np.float32),
+              "w_c": ((3, 5, 2), "bfloat16"), "w_d": ((), np.float32),
+              "w_e": ((2500,), "bfloat16")}
+    params = {n: jnp.asarray(rng.normal(0, 0.5, s).astype(np.float32))
+              .astype(dt) for n, (s, dt) in shapes.items()}
+    grads = {n: jnp.asarray(rng.normal(0, 0.1, s).astype(np.float32))
+             for n, (s, _) in shapes.items()}
+    slots = {n: tuple(jnp.zeros_like(v) for _ in range(nslots))
+             for n, v in params.items()}
+    lrs = {n: 0.05 * (i + 1) for i, n in enumerate(shapes)}
+    wds = {n: 1e-4 * i for i, n in enumerate(shapes)}
+    hyp = np.array([1.5, 0.25, 0.9, 0.999, 1e-8], np.float32)
+
+    from mxnet_tpu.optimizer import SGD, Adam
+
+    opt = (SGD(momentum=0.9 if nslots else 0.0) if kind == "sgd"
+           else Adam())
+    plan = pallas_update.plan_for(opt, params, list(shapes),
+                                  jnp.bfloat16, interpret=True)
+    assert plan is not None and set(plan.buckets) == {"float32",
+                                                      "bfloat16"}
+    w_slabs = plan.pack(params)
+    g_slabs = plan.pack(grads, dtype_of_bucket=plan.grad_dtype)
+    slot_slabs = plan.pack_slots(slots)
+    wc = plan.cast_slabs(w_slabs)
+    lrb, wdb = plan.lr_wd_blocks(lrs, wds)
+    new_w, new_slots, new_wc = plan.apply(
+        w_slabs, g_slabs, slot_slabs, wc, lrb, wdb, jnp.asarray(hyp))
+    got_w = plan.unpack_all(new_w)
+    got_s = plan.unpack_slots(new_slots)
+
+    import functools
+
+    import jax
+
+    # the reference chain is JITTED, like the real per-parameter XLA
+    # applies (eager op-by-op rounding differs from XLA's fused FMAs)
+    @functools.partial(jax.jit, static_argnums=(5,))
+    def ref_chain(w, g, s, lr, wd, store_dtype):
+        nw, ns = pallas_update._update_math(
+            kind, nslots, w.astype(jnp.float32), g.astype(jnp.float32),
+            tuple(x.astype(jnp.float32) for x in s), lr, wd,
+            tuple(jnp.asarray(hyp)[i]
+                  for i in range(5 if kind == "adam" else 3)))
+        return nw.astype(store_dtype), tuple(x.astype(store_dtype)
+                                             for x in ns)
+
+    for n, v in params.items():
+        ref_w, ref_s = ref_chain(v, grads[n], slots[n],
+                                 jnp.float32(lrs[n]), jnp.float32(wds[n]),
+                                 v.dtype)
+        assert got_w[n].dtype == v.dtype
+        ref = np.asarray(ref_w.astype(jnp.float32), np.float64)
+        got = np.asarray(got_w[n].astype(jnp.float32), np.float64)
+        if kind == "sgd":
+            assert np.array_equal(ref, got), n
+        else:
+            np.testing.assert_allclose(got, ref, rtol=0, atol=1e-6,
+                                       err_msg=n)
+        for i in range(nslots):
+            assert got_s[n][i].dtype == v.dtype
+    # the recast slabs are exactly cast(new master)
+    for bk in new_wc:
+        expect = new_w[bk].astype(jnp.bfloat16)
+        assert np.array_equal(np.asarray(expect, np.float32),
+                              np.asarray(new_wc[bk], np.float32)), bk
+
+
+def test_pack_unpack_roundtrip():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.optimizer import SGD
+
+    rng = np.random.RandomState(9)
+    params = {"a": jnp.asarray(rng.normal(size=(17, 3))
+                               .astype(np.float32)),
+              "b": jnp.asarray(rng.normal(size=(2050,))
+                               .astype(np.float32))}
+    plan = pallas_update.plan_for(SGD(momentum=0.9), params, ["a", "b"],
+                                  None, interpret=True)
+    slabs = plan.pack(params)
+    back = plan.unpack_all(slabs)
+    for n, v in params.items():
+        assert back[n].shape == v.shape
+        assert np.array_equal(np.asarray(back[n]), np.asarray(v)), n
+    slots = {n: (jnp.ones_like(v),) for n, v in params.items()}
+    sback = plan.unpack_slots(plan.pack_slots(slots))
+    for n in slots:
+        assert np.array_equal(np.asarray(sback[n][0]),
+                              np.asarray(slots[n][0])), n
+
+
+# ---------------------------------------------------------------------------
+# fallback matrix + tripwires
+# ---------------------------------------------------------------------------
+def test_update_path_tripwire_fallbacks():
+    """NAG (SGD subclass, different math) and RMSProp must fall back to
+    the per-parameter XLA path even when armed; plain SGD re-arms."""
+    with _armed():
+        mod = _make_module("nag")
+        assert mod._fused_step._plan is None
+        assert pallas_update.UPDATE_PATH["last"] == "xla"
+        mod = _make_module("rmsprop")
+        assert mod._fused_step._plan is None
+        assert pallas_update.UPDATE_PATH["last"] == "xla"
+        mod = _make_module("sgd")
+        assert mod._fused_step._plan is not None
+        assert pallas_update.UPDATE_PATH["last"] == "pallas"
+    # unarmed: always the XLA path
+    mod = _make_module("sgd")
+    assert mod._fused_step._plan is None
+    assert pallas_update.UPDATE_PATH["last"] == "xla"
+
+
+def test_plan_for_fallback_matrix():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.optimizer import SGD
+
+    params = {"w": jnp.zeros((8, 8), jnp.float32)}
+    opt = SGD(momentum=0.9)
+    # mesh-sharded masters: slabs would force replication
+    assert pallas_update.plan_for(opt, params, ["w"], None,
+                                  mesh=object()) is None
+    # unsupported master dtype
+    assert pallas_update.plan_for(
+        opt, {"w": jnp.zeros((4,), jnp.float16)}, ["w"], None) is None
+    # nothing trainable
+    assert pallas_update.plan_for(opt, params, [], None) is None
+    # supported: builds
+    assert pallas_update.plan_for(opt, params, ["w"], None) is not None
+
+
+def test_artifact_meta_and_pallas_fallback_tripwire():
+    """An armed step's artifact carries meta['pallas_update'] and lints
+    green (pallas-update info); the SAME artifact with its pallas_call
+    scrubbed — a silent fallback — is a RED flop-dtype run."""
+    from mxnet_tpu import analysis
+
+    with _armed():
+        mod = _make_module("sgd", "bfloat16")
+        for b in _batches(2):
+            mod.forward_backward(b)
+            mod.update()
+        art = mod._fused_step.artifact()
+    assert art.meta.get("pallas_update") is True
+    report = analysis.run_passes([art])
+    codes = {f.code for f in report.findings}
+    assert "pallas-update" in codes
+    assert not report.errors, [f.message for f in report.findings
+                               if f.severity == "error"]
+
+    scrubbed = dataclasses.replace(
+        art, jaxpr_text=(art.jaxpr_text or "").replace("pallas_call",
+                                                       "scrubbed"),
+        stablehlo_text=(art.stablehlo_text or "").replace(
+            "tpu_custom_call", "scrubbed"))
+    report = analysis.run_passes([scrubbed])
+    errs = [f for f in report.findings if f.severity == "error"]
+    assert any(f.code == "pallas-fallback" for f in errs), codes
+
+
+def test_donation_and_retrace_with_kernel_armed():
+    """Zero new retraces / donation regressions: every donated leaf
+    (params, slots, aux, the wcast slabs) aliases, and the step traces
+    exactly once across many runs."""
+    from mxnet_tpu import analysis
+
+    with _armed():
+        mod = _make_module("adam", "bfloat16")
+        for b in _batches(6):
+            mod.forward_backward(b)
+            mod.update()
+        step = mod._fused_step
+        assert step.trace_count == step.programs_built == 1
+        art = step.artifact()
+    report = analysis.run_passes([art])
+    aliased = [f for f in report.findings if f.code == "aliased"]
+    assert aliased and "donated buffers aliased" in aliased[0].message
+    assert not report.errors
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume bit-identity with the kernel armed
+# ---------------------------------------------------------------------------
+def test_kill_and_resume_bit_identical_with_kernel(tmp_path):
+    """fit() killed mid-epoch and resumed from the last fence produces
+    BIT-identical params to the uninterrupted run WITH the fused update
+    kernel armed — the persistent compute slabs restore as pure
+    cast(master) caches, and Adam's bias correction resumes at the true
+    update count t (the elastic sidecar)."""
+    from mxnet_tpu import checkpoint, elastic
+
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(96, 10)).astype(np.float32)
+    Y = rng.randint(0, 4, size=(96,)).astype(np.float32)
+
+    def fit(tag, ctl=None):
+        mx.random.seed(42)
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu(),
+                            compute_dtype="bfloat16",
+                            logger=logging.Logger("pallas-" + tag))
+        mod.fit(NDArrayIter(X, Y, batch_size=8), optimizer="adam",
+                optimizer_params={"learning_rate": 5e-3},
+                initializer=mx.initializer.Xavier(), num_epoch=2,
+                eval_metric="acc", elastic=ctl)
+        assert mod._fused_step._plan is not None
+        assert pallas_update.UPDATE_PATH["last"] == "pallas"
+        params, _ = mod.get_params()
+        return {n: v.asnumpy() for n, v in params.items()}
+
+    with _armed():
+        ref = fit("uninterrupted")
+        d = str(tmp_path / "ck")
+        inj = elastic.FaultInjector().kill_at(17)
+        ctl = elastic.ElasticController(
+            checkpointer=elastic.Checkpointer(d, period=5,
+                                              async_write=False),
+            injector=inj)
+        with pytest.raises(elastic.WorkerKilled):
+            fit("killed", ctl)
+        assert checkpoint.latest_step(d) == 15
+        ctl2 = elastic.ElasticController(
+            checkpointer=elastic.Checkpointer(d, period=5,
+                                              async_write=False))
+        got = fit("resumed", ctl2)
+        assert ctl2.recoveries == 1
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), \
+            "%s differs after resume" % name
+
+
+# ---------------------------------------------------------------------------
+# pricing: the HBM diet, as numbers
+# ---------------------------------------------------------------------------
+def test_priced_update_cost_headline_ratio():
+    """At the headline configuration (f32 masters, bf16 compute,
+    SGD-momentum) the fused pass's priced optimizer-phase bytes are
+    <= 0.5x the per-parameter chain's — the bench.py acceptance
+    assert, pinned here at ResNet-shaped sizes."""
+    import jax
+    import jax.numpy as jnp
+
+    specs = {"conv%d" % i: jax.ShapeDtypeStruct((64, 64, 3, 3),
+                                                jnp.float32)
+             for i in range(12)}
+    specs.update({"bn%d" % i: jax.ShapeDtypeStruct((64,), jnp.float32)
+                  for i in range(12)})
+    priced = pallas_update.priced_update_cost(specs, "sgd", 1,
+                                              jnp.bfloat16)
+    assert set(priced["phases"]) == {"cast", "rescale", "clip", "update",
+                                     "recast"}
+    assert priced["fused_bytes"] <= 0.5 * priced["per_param_bytes"], \
+        priced
+    # pure-f32 (no cast/recast phases) still shrinks, just less
+    f32 = pallas_update.priced_update_cost(specs, "sgd", 1, None)
+    assert set(f32["phases"]) == {"rescale", "clip", "update"}
+    assert f32["fused_bytes"] < f32["per_param_bytes"]
+
+
+def test_priced_update_cost_for_step_live():
+    """The live-step convenience wrapper prices the real module's specs
+    and the opt_update roofline row publishes whichever path is armed.
+    (No fused<per_param assert here: this module's params are TOY-sized,
+    where the (16, 128) per-param block floor dominates — the ratio
+    claim is asserted at realistic sizes above and at the ResNet
+    headline in bench.py.)"""
+    from mxnet_tpu.train_step import _weak_update_prober
+
+    with _armed():
+        mod = _make_module("sgd", "bfloat16")
+        for b in _batches(2):
+            mod.forward_backward(b)
+            mod.update()
+        step = mod._fused_step
+        priced = pallas_update.priced_update_cost_for_step(step)
+        assert priced is not None
+        assert priced["fused_bytes"] > 0 and priced["per_param_bytes"] > 0
+        row = _weak_update_prober(step)()
+        assert row["update_path"] == "pallas"
+        assert row["bytes"] == priced["fused_bytes"]
+        assert row["flops"] == 0
+    # unarmed step: the row carries the per-parameter price
+    mod = _make_module("sgd", "bfloat16")
+    for b in _batches(2):
+        mod.forward_backward(b)
+        mod.update()
+    row = _weak_update_prober(mod._fused_step)()
+    assert row["update_path"] == "xla"
+    assert row["bytes"] == row["per_param_bytes"]

@@ -180,8 +180,12 @@ def test_pipeline_module_fit_converges():
         num_microbatches=4, context=[mx.cpu(i) for i in range(8)])
     it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=16)
     np.random.seed(7)  # Xavier draws from global np.random; pin the init
+    # lr 0.3 (was 0.5): on jax 0.4.37's XLA:CPU numerics the 0.5 run
+    # overshoots and plateaus at 0.89 accuracy (env drift, reproduced on
+    # the seed tree); 0.3 converges cleanly to 1.0, keeping the > 0.9
+    # assertion strong instead of skip-marking the test
     pipe.fit(it, optimizer="sgd",
-             optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+             optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
              initializer=mx.initializer.Xavier(), num_epoch=30,
              eval_metric="acc")
     it.reset()
@@ -564,8 +568,12 @@ def test_pipeline_module_remat_trains():
         context=[mx.cpu(i) for i in range(8)])
     it = NDArrayIter({"data": X}, {"softmax_label": y}, batch_size=16)
     np.random.seed(7)
+    # lr 0.3 (was 0.5): on jax 0.4.37's XLA:CPU numerics the 0.5 run
+    # overshoots and plateaus at 0.89 accuracy (env drift, reproduced on
+    # the seed tree); 0.3 converges cleanly to 1.0, keeping the > 0.9
+    # assertion strong instead of skip-marking the test
     pipe.fit(it, optimizer="sgd",
-             optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+             optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
              initializer=mx.initializer.Xavier(), num_epoch=30,
              eval_metric="acc")
     it.reset()

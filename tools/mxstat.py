@@ -16,6 +16,13 @@ Usage:
   in).
 * ``tools/mxstat.py --snapshot``      — print the current process-wide
   registry snapshot (mostly useful from an interactive session).
+* ``tools/mxstat.py --diff A.json B.json`` — headline / MFU / bytes
+  deltas between two bench JSON contracts (``BENCH_r*.json``): the
+  headline metric's value, the aggregate byte-ish extras
+  (``opt_update_bytes``, ``all_to_all_bytes``, ``dispatch_bytes``) and
+  a per-program join of the two ``mfu_table``s (bytes, flops, wall_s,
+  mfu), with absolute and percent deltas — the perf trajectory across
+  PRs as one readable table instead of two hand-diffed JSON blobs.
 * ``tools/mxstat.py --smoke``         — tier-1 CI mode
   (tests/test_bench_contract.py invokes it): drive the registry /
   timeline / roofline machinery end to end WITHOUT jax — concurrent
@@ -62,6 +69,111 @@ def _load_rows(path):
             elif obj.get("metric") and isinstance(obj.get("value"), list):
                 rows = obj["value"]
     return rows
+
+
+def _load_contract(path):
+    """The last bench-contract object (has "metric" and "value") in a
+    JSON or JSON-lines file; None when the file carries none."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        payloads = [json.loads(text)]
+    except ValueError:
+        payloads = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                try:
+                    payloads.append(json.loads(line))
+                except ValueError:
+                    continue
+    found = None
+    for obj in payloads:
+        if isinstance(obj, dict) and obj.get("metric") is not None \
+                and "value" in obj:
+            found = obj
+    return found
+
+
+def _delta_row(label, a, b):
+    """One diff line: label, a, b, absolute delta, percent delta."""
+    if not (isinstance(a, (int, float)) and isinstance(b, (int, float))):
+        return [label, str(a), str(b), "-", "-"]
+    d = b - a
+    pct = ("%+.2f%%" % (100.0 * d / a)) if a else "-"
+    fmt = "%+d" if isinstance(a, int) and isinstance(b, int) else "%+.4g"
+    return [label, "%.6g" % a, "%.6g" % b, fmt % d, pct]
+
+
+def _render_diff_table(rows):
+    table = [["field", "a", "b", "delta", "pct"]] + rows
+    widths = [max(len(r[i]) for r in table) for i in range(5)]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(c.rjust(w) if j else c.ljust(w)
+                               for j, (c, w) in enumerate(zip(r, widths))))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _flatten_bytes_extras(obj, prefix=""):
+    """The byte-ish scalar extras of a contract line, flattened:
+    opt_update_bytes.fused_bytes, dispatch_bytes.sort.bytes, ..."""
+    out = {}
+    for key, val in sorted((obj or {}).items()):
+        if key in ("mfu_table",) or key.startswith("_"):
+            continue
+        name = prefix + key
+        if isinstance(val, dict):
+            out.update(_flatten_bytes_extras(val, name + "."))
+        elif isinstance(val, (int, float)) and not isinstance(val, bool) \
+                and ("bytes" in name or name.endswith((".ratio",
+                                                       ".count"))):
+            out[name] = val
+    return out
+
+
+def diff(a_path, b_path, out=None):
+    """Print headline/MFU/bytes deltas between two bench contracts.
+    Returns 0, or 1 when either file carries no contract line."""
+    out = out if out is not None else sys.stdout
+    a = _load_contract(a_path)
+    b = _load_contract(b_path)
+    if a is None or b is None:
+        print("no bench contract line found in %s"
+              % (a_path if a is None else b_path), file=sys.stderr)
+        return 1
+    rows = []
+    label = a["metric"] if a["metric"] == b["metric"] else \
+        "%s -> %s" % (a["metric"], b["metric"])
+    rows.append(_delta_row("headline: %s [%s]" % (label,
+                                                  a.get("unit", "?")),
+                           a.get("value"), b.get("value")))
+    if a.get("vs_baseline") is not None \
+            and b.get("vs_baseline") is not None:
+        rows.append(_delta_row("vs_baseline", a["vs_baseline"],
+                               b["vs_baseline"]))
+    fa, fb = _flatten_bytes_extras(a), _flatten_bytes_extras(b)
+    keys = sorted(set(fa) | set(fb))
+    for k in keys:
+        rows.append(_delta_row(k, fa.get(k, "-"), fb.get(k, "-")))
+    # per-program mfu_table join
+    ta = {r.get("program"): r for r in a.get("mfu_table") or []}
+    tb = {r.get("program"): r for r in b.get("mfu_table") or []}
+    for prog in sorted(set(ta) | set(tb)):
+        ra, rb = ta.get(prog, {}), tb.get(prog, {})
+        for col in ("bytes", "flops", "wall_s", "mfu",
+                    "collective_bytes", "gather_bytes",
+                    "sort_scatter_bytes"):
+            va, vb = ra.get(col), rb.get(col)
+            if va is None and vb is None:
+                continue
+            rows.append(_delta_row("%s.%s" % (prog, col),
+                                   va if va is not None else "-",
+                                   vb if vb is not None else "-"))
+    print(_render_diff_table(rows), file=out)
+    return 0
 
 
 def smoke():
@@ -139,7 +251,46 @@ def smoke():
             and (e["ph"] != "i" or e.get("s") in ("t", "p", "g"))
             for e in evs)
 
-    # 5. the MFU table joins timings with static costs
+    # 5. --diff round-trip: two synthetic bench contracts through the
+    # real loader + table (jax-free), checking the joined deltas land
+    import io
+
+    with tempfile.TemporaryDirectory(prefix="mxstat_diff_") as tmp:
+        a_line = {"metric": "resnet50_train_imgs_per_sec_bs256",
+                  "value": 2442.6, "unit": "img/s", "vs_baseline": 13.45,
+                  "opt_update_bytes": {"per_param_bytes": 1200,
+                                       "fused_bytes": 1200,
+                                       "ratio": 1.0},
+                  "mfu_table": [{"program": "train_step", "calls": 10,
+                                 "wall_s": 1.0, "flops": 100,
+                                 "bytes": 1000, "mfu": 0.15}]}
+        b_line = {"metric": "resnet50_train_imgs_per_sec_bs256",
+                  "value": 2520.9, "unit": "img/s", "vs_baseline": 13.89,
+                  "opt_update_bytes": {"per_param_bytes": 1200,
+                                       "fused_bytes": 540,
+                                       "ratio": 0.45},
+                  "mfu_table": [{"program": "train_step", "calls": 10,
+                                 "wall_s": 0.9, "flops": 100,
+                                 "bytes": 800, "mfu": 0.17}]}
+        pa = os.path.join(tmp, "a.json")
+        pb = os.path.join(tmp, "b.json")
+        with open(pa, "w") as f:
+            f.write("not json\n" + json.dumps(a_line) + "\n")
+        with open(pb, "w") as f:
+            f.write(json.dumps(b_line))
+        buf = io.StringIO()
+        rc = diff(pa, pb, out=buf)
+        text = buf.getvalue()
+        checks["diff_exit"] = rc == 0
+        checks["diff_headline"] = "+78.3" in text and "+3.21%" in text
+        checks["diff_bytes"] = "opt_update_bytes.fused_bytes" in text \
+            and "-660" in text and "-55.00%" in text
+        checks["diff_programs"] = "train_step.bytes" in text \
+            and "-200" in text
+        checks["diff_missing"] = diff(pa, os.devnull,
+                                      out=io.StringIO()) == 1
+
+    # 6. the MFU table joins timings with static costs
     acc = ProgramAccounting()
     for _ in range(10):
         acc.note("train_step", 0.01)
@@ -176,10 +327,16 @@ def main(argv=None):
                     "roofline machinery synthetically and self-check")
     ap.add_argument("--snapshot", action="store_true",
                     help="print the process-wide metrics snapshot as JSON")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    default=None,
+                    help="print headline/MFU/bytes deltas between two "
+                    "bench JSON contracts")
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
 
     if args.smoke:
         return smoke()
+    if args.diff:
+        return diff(args.diff[0], args.diff[1])
     if args.snapshot:
         from mxnet_tpu import obs
 
