@@ -447,6 +447,36 @@ register("MXNET_PEAK_FLOPS", float, 0.0,
          "kind up in the TPU spec table; unknown devices (the CPU "
          "harness) then report mfu=null while flops/bytes/wall stay "
          "populated.")
+register("MXNET_FLEET_SWAP", bool, True,
+         "Arm preemption/swap in the paged serving loop "
+         "(decode.DecodeServer / serve.swap): when the page pool cannot "
+         "admit the queue head for MXNET_FLEET_DECODE_BOUND consecutive "
+         "decode iterations, the lowest-priority (then longest-running) "
+         "slot's pages move to host RAM as a restorable record, the "
+         "waiter admits on the freed pages, and the victim re-queues — "
+         "readmitted later by restoring its pages bit-exactly at the "
+         "same ring positions, so a long decode can no longer wedge "
+         "admission.  0 = classic backpressure only (the queue waits "
+         "for retirements).")
+register("MXNET_FLEET_DECODE_BOUND", int, 8,
+         "Fair-admission bound for the paged serving loop: consecutive "
+         "pool-gate-blocked decode iterations tolerated before a "
+         "preemption swap-out (MXNET_FLEET_SWAP) makes room for the "
+         "queue head.  Bounds the admission-starvation tail a long "
+         "wrapped decode can inflict (single host AND fleet p95 TTFT); "
+         "equal-priority thrash is bounded to one swap per this many "
+         "iterations — round-robin time slicing, every request still "
+         "finishes.  0 disables the bound (swap never triggers on "
+         "fairness grounds).")
+register("MXNET_FLEET_PREFILL_THRESHOLD", float, 0.5,
+         "Disaggregation routing threshold (serve.fleet.Router): a "
+         "prompt whose best cache-aware chain match covers at least "
+         "this fraction of its tokens admits DIRECTLY on the matching "
+         "decode host (its chunked prefill computes only the tail); "
+         "colder prompts go to a dedicated prefill worker, whose "
+         "committed pages migrate to the least-loaded decode host "
+         "(DistServe-style prefill/decode split).  Only consulted when "
+         "the router has prefill workers.")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

@@ -291,7 +291,8 @@ class DecodePredictor:
         # Probes (lowering for artifact/FLOP text) set _probing and don't
         # count.
         self.trace_counts = {"prefill": 0, "decode": 0, "verify": 0,
-                             "chunk": 0, "fork": 0, "commit": 0}
+                             "chunk": 0, "fork": 0, "commit": 0,
+                             "extract": 0, "install": 0}
         self._probing = False
         if self._paged:
             # paged programs take (page tables, active mask) as DATA; the
@@ -308,6 +309,14 @@ class DecodePredictor:
             self._commit_fn = jax.jit(
                 self._commit_impl,
                 donate_argnums=(0, 1) if self._donate else ())
+            # page migration/swap: gather a slot's table row out of the
+            # pools / scatter saved page contents back in.  Row ids are
+            # DATA — one trace each serves every migration, swap-out and
+            # readmit (serve.fleet / serve.swap)
+            self._extract_fn = jax.jit(self._extract_impl)
+            self._install_fn = jax.jit(
+                self._install_impl,
+                donate_argnums=(0,) if self._donate else ())
             self._manager = None          # serve.PagedKVManager, per batch
             self._pools_template = None   # per-node cache avals (probed)
             self._paged_lens = None       # host mirror for standalone use
@@ -711,6 +720,56 @@ class DecodePredictor:
                                            (slot, jnp.int32(0)))
         return lens, tok
 
+    def _extract_impl(self, caches, row):
+        """Gather one slot's (M,) table row out of every pool — per node
+        an (M, page_tokens, E) block of page contents, data AND scale
+        planes (QuantKV rides the tree).  The page ids are data, so ONE
+        trace serves every migration and swap-out; unmapped entries
+        gather the scratch page, whose content is never read."""
+        import jax.tree_util as jtu
+
+        if not self._probing:
+            self.trace_counts["extract"] += 1
+        return jtu.tree_map(lambda pool: pool[row], caches)
+
+    def _install_impl(self, caches, row, data):
+        """Scatter extracted page contents back into the pools at a
+        (freshly allocated) table row — the receiving half of page
+        migration and swap-in.  Unmapped row entries are 0: their
+        writes land in the scratch page (harmless by design), so one
+        fixed-(M,) program carries any live page count.  Donated like
+        the step programs — the pools update in place."""
+        import jax.tree_util as jtu
+
+        if not self._probing:
+            self.trace_counts["install"] += 1
+        return jtu.tree_map(lambda pool, d: pool.at[row].set(d),
+                            caches, data)
+
+    def extract_pages(self, caches, row):
+        """Host-side (numpy) copy of one slot's pages: the shippable
+        payload of the page-migration protocol — quantized data plus
+        per-(token, head) scales, in table-row order."""
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+
+        with _obs.program_span("page_extract"):
+            out = self._extract_fn(caches,
+                                   jnp.asarray(row, jnp.int32).reshape(-1))
+            return jtu.tree_map(lambda x: np.asarray(x), out)
+
+    def install_pages(self, caches, row, data):
+        """Write a shipped page payload into this predictor's pools at
+        ``row`` (0 = unmapped, redirected to the scratch page).  Returns
+        the updated pools; the input pools are donated."""
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+
+        with _obs.program_span("page_install"):
+            return self._install_fn(
+                caches, jnp.asarray(row, jnp.int32).reshape(-1),
+                jtu.tree_map(jnp.asarray, data))
+
     def _probe_cache_shapes(self):
         """Per-attention-node cache avals — (1, C, E) K/V (or QuantKV)
         from an abstract prefill at (1, 1), the shape source for building
@@ -820,7 +879,11 @@ class DecodePredictor:
     def paged_prepare(self, state, lens_h, width, active=None):
         """Make positions [lens, lens + width) of every active row
         writable (allocate/fork through the manager, run the forks) and
-        return ``(state', tables, active)`` ready for the step."""
+        return ``(state', tables, active)`` ready for the step.  The
+        device copies of the tables and the activity mask are cached
+        against the manager's mutation version / the mask bytes — a
+        steady-state decode tick (no page allocated, no fork, same
+        occupancy) re-ships NOTHING to the device."""
         import jax.numpy as jnp
 
         mgr = self._manager
@@ -833,8 +896,17 @@ class DecodePredictor:
                                     int(lens_h[s]) + int(width))
                 if copies:
                     caches = self._run_forks(caches, copies)
+        cached = getattr(self, "_tables_dev", None)
+        if cached is None or cached[0] is not mgr \
+                or cached[1] != mgr.version:
+            self._tables_dev = (mgr, mgr.version,
+                                jnp.asarray(mgr.tables))
+        act_key = act.tobytes()
+        cached = getattr(self, "_act_dev", None)
+        if cached is None or cached[0] != act_key:
+            self._act_dev = (act_key, jnp.asarray(act))
         return (DecodeState(caches, state.lens, state.tok),
-                jnp.asarray(mgr.tables), jnp.asarray(act))
+                self._tables_dev[2], self._act_dev[1])
 
     def paged_step(self, state, lens_h, key=None, active=None):
         """One paged decode step: ensure pages, run forks, step.  The
@@ -921,12 +993,17 @@ class DecodePredictor:
         self._chunk_widths.add(w)
         pos = int(start)
         tok = probs = None
+        greedy = self._greedy
         while pos < total:
             n = min(w, total - pos)
             copies = mgr.ensure(slot, pos, pos + n)
             if copies:
                 caches = self._run_forks(caches, copies)
-            key, sub = jax.random.split(key)
+            # greedy sampling never reads the key: skip the per-chunk
+            # split dispatch
+            sub = key if greedy else None
+            if sub is None:
+                key, sub = jax.random.split(key)
             with _obs.program_span("prefill"):
                 caches, probs, tok = self._chunk_fn(
                     self._env, caches,
@@ -1620,14 +1697,29 @@ class DecodeServer:
     design: the serving loop IS the schedule (Orca iteration-level
     scheduling), callers queue requests with :meth:`submit` and drain with
     :meth:`run`.
+
+    The paged loop is additionally a fleet citizen
+    (``mxnet_tpu.serve.fleet``, docs/serving_fleet.md): it runs as a
+    persistent SESSION one :meth:`serve_tick` at a time so a router can
+    interleave hosts, accepts page-restorable records through
+    :meth:`inject` (migrated prefills, swapped-out requests), publishes
+    its routing view via :meth:`serve_summary` (``/metrics.json``), and
+    preempts under pressure — a higher-priority waiter, or any waiter
+    after ``MXNET_FLEET_DECODE_BOUND`` pool-blocked iterations, swaps
+    the lowest-priority slot's pages to host RAM
+    (``MXNET_FLEET_SWAP``); the victim readmits bit-exactly here or on
+    any other host.
     """
 
     def __init__(self, predictor, max_prefill, slots=None, eos_id=None,
                  max_new_tokens=None, seed=0, spec_k=None, proposer=None,
-                 draft=None, metrics_port=None):
+                 draft=None, metrics_port=None, host=None):
         from . import config as _config
 
         self._pred = predictor
+        # fleet identity: the per-host label on the mx_fleet_* metric
+        # families (serve.fleet sets it; standalone servers are "local")
+        self._host = str(host) if host is not None else "local"
         self._max_prefill = int(max_prefill)
         if self._max_prefill > predictor.cache_len:
             raise MXNetError("max_prefill %d exceeds the predictor's "
@@ -1682,6 +1774,18 @@ class DecodeServer:
             "mx_spec_proposed", "drafted tokens offered to verify")
         self._m_accepted = _obs.registry.counter(
             "mx_spec_accepted", "drafted tokens accepted by the target")
+        # --- fleet/preemption state (paged loop) ---
+        # fair admission: after this many consecutive pool-gate-blocked
+        # iterations the lowest-priority slot is preempted (swap-out) so
+        # a long decode can no longer wedge the admission gate
+        self._fair_bound = int(_config.get("MXNET_FLEET_DECODE_BOUND"))
+        self._swap_armed = bool(_config.get("MXNET_FLEET_SWAP"))
+        self._preempt_cb = None     # serve.fleet routes records back out
+        self._verify_restore = False   # tests: assert restore bit-parity
+        self._ps = None             # persistent paged session (tick API)
+        self.swap_outs = 0
+        self.swap_ins = 0
+        self._bind_host_metrics(self._host)
         # Prometheus-text exporter (heritage: kvstore_server.py's server
         # process contract): MXNET_METRICS_PORT / metrics_port= arms the
         # process-wide HTTP sidecar serving the registry + timeline —
@@ -1690,6 +1794,49 @@ class DecodeServer:
             metrics_port = int(_config.get("MXNET_METRICS_PORT"))
         self.metrics_server = _obs.serve_metrics(metrics_port) \
             if metrics_port else None
+        # /metrics.json grows the fleet-routing summary: the chain
+        # digest + load gauges a remote router scores this host by —
+        # one mx_serve_summary:<host> section PER SERVER, so several
+        # servers sharing the process-wide port cannot clobber each
+        # other's routing view
+        self._summary_key = None
+        self._register_summary()
+
+    def _register_summary(self):
+        """(Re)register this server's ``/metrics.json`` section under
+        its current host label (renames drop the old key)."""
+        if getattr(self, "metrics_server", None) is None:
+            return
+        key = "mx_serve_summary:%s" % self._host
+        if self._summary_key and self._summary_key != key:
+            self.metrics_server.remove_json(self._summary_key)
+        self._summary_key = key
+        self.metrics_server.add_json(key, self.serve_summary)
+
+    def _bind_host_metrics(self, host):
+        """(Re)bind the per-host mx_fleet_* children — the fleet layer
+        names its hosts after construction, and the labeled series must
+        follow the name or every host's counts land on one label."""
+        self._host = str(host)
+        self._register_summary()
+        lab = {"host": self._host}
+        self._m_swapped_pages = _obs.registry.counter(
+            "mx_fleet_swapped_pages",
+            "pages moved to host RAM by preemption swap-outs",
+            labels=("host",)).labels(**lab)
+        self._m_migrated_pages = _obs.registry.counter(
+            "mx_fleet_migrated_pages",
+            "pages installed from migrated/restored records",
+            labels=("host",)).labels(**lab)
+        self._m_queue_depth = _obs.registry.gauge(
+            "mx_fleet_queue_depth", "requests waiting in the host queue",
+            labels=("host",)).labels(**lab)
+        self._m_free_pages = _obs.registry.gauge(
+            "mx_fleet_free_pages", "free pages in the host's KV pool",
+            labels=("host",)).labels(**lab)
+        self._m_ttft = _obs.registry.histogram(
+            "mx_fleet_ttft", "seconds from submit to first token",
+            labels=("host",)).labels(**lab)
 
     @property
     def accept_rate(self):
@@ -1712,8 +1859,12 @@ class DecodeServer:
         self._m_proposed.inc(proposed)
         self._m_accepted.inc(accepted)
 
-    def submit(self, tokens, max_new_tokens=None):
-        """Queue a prompt (1-D int sequence); returns the request id."""
+    def submit(self, tokens, max_new_tokens=None, priority=0):
+        """Queue a prompt (1-D int sequence); returns the request id.
+
+        ``priority`` matters only under preemption (paged mode with
+        ``MXNET_FLEET_SWAP``): higher values are swapped out LAST when
+        the pool runs dry.  Admission order stays FIFO."""
         tokens = np.asarray(tokens).reshape(-1)
         if tokens.size > self._max_prefill:
             raise MXNetError("prompt length %d exceeds max_prefill %d"
@@ -1722,8 +1873,26 @@ class DecodeServer:
         self._next_id += 1
         cap = int(max_new_tokens) if max_new_tokens is not None \
             else self._max_new
-        self._queue.append((rid, tokens, cap))
+        self._queue.append({"rid": rid, "prompt": tokens, "cap": cap,
+                            "prio": int(priority), "swap": None})
         self._req[rid] = {"submit": time.time()}
+        return rid
+
+    def inject(self, record, front=False):
+        """Queue a restorable :class:`~mxnet_tpu.serve.swap.
+        SwappedRequest` — a page-migrated prefill from a dedicated
+        prefill worker, or a request another host swapped out.  The
+        record admits through the normal reservation gate and restores
+        by installing its saved pages (no prefill); SLO timestamps carry
+        over so fleet TTFT stays honest.  Returns this host's rid."""
+        rid = self._next_id
+        self._next_id += 1
+        entry = {"rid": rid, "prompt": record.prompt, "cap": record.cap,
+                 "prio": record.priority, "swap": record}
+        (self._queue.appendleft if front else self._queue.append)(entry)
+        rec = {"submit": record.submit_ts
+               if record.submit_ts is not None else time.time()}
+        self._req[rid] = rec
         return rid
 
     # retained retired-request records (stats percentiles); older ones
@@ -1743,6 +1912,7 @@ class DecodeServer:
         rec["retire"] = now
         rec["tokens"] = int(ntokens)
         first = rec.get("first", now)
+        self._m_ttft.observe(max(first - rec["submit"], 0.0))
         _prof.record_request(
             rec.get("admit", rec["submit"]) - rec["submit"],
             first - rec["submit"], ntokens, now - first)
@@ -1756,7 +1926,7 @@ class DecodeServer:
         """Append a window of emitted tokens to a request, honoring its
         cap and retiring at an EOS inside the window (shared by the
         dense and paged loops — ONE copy of the retirement rule)."""
-        _, toks, max_new = rec
+        toks, max_new = rec["toks"], rec["cap"]
         for t in emitted:
             if len(toks) >= max_new:
                 break
@@ -1770,10 +1940,11 @@ class DecodeServer:
         slot — plus ``on_retire(slot)`` for loop-specific cleanup (the
         paged loop frees the slot's pages here, immediately)."""
         for slot in list(active):
-            rid, toks, max_new = active[slot]
+            rec = active[slot]
+            rid, toks = rec["rid"], rec["toks"]
             if (self._eos_id is not None and toks
                     and toks[-1] == self._eos_id) \
-                    or len(toks) >= max_new:
+                    or len(toks) >= rec["cap"]:
                 results[rid] = np.asarray(toks, np.int32)
                 self.tokens_out += len(toks)
                 self._m_tokens.inc(len(toks))
@@ -1813,6 +1984,32 @@ class DecodeServer:
         if getattr(self._pred, "_paged", False) \
                 and self._pred._manager is not None:
             out.update(self._pred._manager.stats())
+        out["swap_outs"] = self.swap_outs
+        out["swap_ins"] = self.swap_ins
+        return out
+
+    def serve_summary(self):
+        """The routing view a fleet front-end scores this host by —
+        served inside ``/metrics.json`` (the ``mx_serve_summary:<host>``
+        key)
+        when the metrics HTTP sidecar is armed, and read directly by an
+        in-process :class:`~mxnet_tpu.serve.fleet.Router`: free-page /
+        queue-depth load signals plus the prefix-cache CHAIN SUMMARY
+        (content-free token-chain hashes, ``PrefixCache.summary``) the
+        cache-aware policy matches prompts against."""
+        mgr = getattr(self._pred, "_manager", None)
+        active = len(self._ps["active"]) if self._ps is not None else 0
+        pending = 1 if self._ps is not None and self._ps["pending"] else 0
+        out = {"host": self._host,
+               "slots": self._slots,
+               "active": active + pending,
+               "queue_depth": len(self._queue),
+               "free_pages": mgr.allocator.free_pages
+               if mgr is not None else None,
+               "swap_outs": self.swap_outs,
+               "chains": None}
+        if mgr is not None and mgr.prefix_cache is not None:
+            out["chains"] = mgr.prefix_cache.summary()
         return out
 
     def run(self):
@@ -1861,7 +2058,8 @@ class DecodeServer:
         while self._queue or active:
             # admit: prefill one request per free slot, splice into batch
             while self._queue and len(active) < self._slots:
-                rid, prompt, max_new = self._queue.popleft()
+                entry = self._queue.popleft()
+                rid, prompt = entry["rid"], entry["prompt"]
                 padded = _pad_window(prompt, self._max_prefill)
                 key, sub = jax.random.split(key)
                 one, _ = self._pred.prefill(padded, prompt.size, sub)
@@ -1880,7 +2078,9 @@ class DecodeServer:
                     key, sub = jax.random.split(key)
                     proposer.admit(padded, prompt.size, slot, self._slots,
                                    sub)
-                active[slot] = [rid, [first], max_new]
+                active[slot] = {"rid": rid, "toks": [first],
+                                "cap": entry["cap"],
+                                "prio": entry["prio"], "prompt": prompt}
                 histories[slot] = list(prompt.astype(np.int64)) + [first]
                 slot_lens[slot] = prompt.size
             retire()
@@ -1916,163 +2116,454 @@ class DecodeServer:
             retire()
         return results
 
-    def _run_paged(self):
-        """The paged serving schedule.
+    # ------------------------------------------------------------------
+    # the paged serving schedule — a persistent SESSION driven one
+    # iteration at a time (:meth:`serve_tick`), so a fleet router
+    # (``serve.fleet``) can interleave hosts, inject migrated state and
+    # collect preemptions between iterations; :meth:`run` drives the
+    # same tick loop to drain the local queue.
+    # ------------------------------------------------------------------
+    def serve_open(self):
+        """Get-or-create the paged serving session: fresh page pools,
+        manager and batch bookkeeping.  Idempotent while a session is
+        live; :meth:`serve_reset` closes it (compiled programs are
+        per-predictor and survive — a reopened session retraces
+        nothing)."""
+        import jax
 
-        Each iteration: (1) gate at most one queued request through the
-        page allocator — reservation failure is BACKPRESSURE, the request
-        stays queued until retirements free pages; (2) advance the
-        in-flight admission by ONE prefill chunk (prefix-cache-matched
-        pages were mapped at the gate, only the tail computes), so a long
-        prompt interleaves with decode instead of stalling the batch;
-        (3) on the final chunk, splice the first token/length into the
-        batch state, publish the prompt's pages to the prefix cache and
+        if self._ps is not None:
+            return self._ps
+        pred = self._pred
+        slots = self._slots
+        self._ps = {
+            "key": jax.random.PRNGKey(self._seed),
+            "state": pred.paged_batch_state(slots),
+            "active": {},       # slot -> request record dict
+            "results": {},
+            "histories": {},
+            "slot_lens": np.zeros(slots, np.int64),
+            "act_mask": np.zeros(slots, np.int32),
+            "pending": None,    # the one admission mid-chunked-prefill
+            "blocked": 0,       # consecutive pool-gate-blocked ticks
+        }
+        return self._ps
+
+    def serve_reset(self):
+        """Close the paged session (pools, manager, batch state).  The
+        next :meth:`serve_open` starts cold — same compiled programs,
+        fresh memory manager.  The predictor's manager is dropped NOW,
+        not at reopen: a fleet router polls :meth:`serve_summary`
+        before the first tick, and scoring prompts against the previous
+        session's ghost chains would mis-route the whole first burst."""
+        self._ps = None
+        if getattr(self._pred, "_paged", False):
+            self._pred._manager = None
+
+    @property
+    def has_work(self):
+        """Whether the paged session still has queued, mid-prefill or
+        decoding requests."""
+        if self._ps is None:
+            return bool(self._queue)
+        ps = self._ps
+        return bool(self._queue or ps["active"] or ps["pending"])
+
+    def serve_results(self, clear=True):
+        """``{rid: np.int32 tokens}`` finished since the session opened
+        (or since the last ``clear``)."""
+        if self._ps is None:
+            return {}
+        out = dict(self._ps["results"])
+        if clear:
+            self._ps["results"].clear()
+        return out
+
+    def _run_paged(self):
+        """Drain the local queue through the tick loop (fresh session
+        per call — :meth:`run`'s historical contract)."""
+        self.serve_reset()
+        self.serve_open()
+        while self.has_work:
+            self.serve_tick()
+        return self.serve_results(clear=True)
+
+    def _paged_limit(self):
+        limit = self._pred.cache_len
+        prop = self._proposer
+        if prop is not None and getattr(prop, "cache_len", None):
+            limit = min(limit, prop.cache_len + 1)
+        return limit
+
+    def _on_retire_paged(self, ps):
+        def on_retire(slot):
+            ps["act_mask"][slot] = 0
+            # pages back to the pool NOW — the very next admission
+            # gate sees them (not "at next admission")
+            self._pred._manager.free_slot(slot)
+        return on_retire
+
+    def _admit_one(self, ps):
+        """Gate the queue head: a fresh prompt starts chunked prefill
+        (returns its pending dict, stored in ``ps``); a restorable
+        record (swap-in / migrated prefill) installs its pages and the
+        slot activates immediately (returns True).  None = the pool
+        cannot cover it yet (backpressure)."""
+        mgr = self._pred._manager
+        entry = self._queue[0]
+        if entry["swap"] is not None:
+            return self._try_restore(ps, entry)
+        rid, prompt, cap = entry["rid"], entry["prompt"], entry["cap"]
+        gate = mgr.gate(prompt, prompt.size, cap, self._spec_k)
+        if gate is None:
+            return None
+        self._queue.popleft()
+        matched, pages, reserve_n = gate
+        slot = next(s for s in range(self._slots)
+                    if s not in ps["active"])
+        mgr.map_slot(slot, pages, reserve_n)
+        self._req[rid]["admit"] = time.time()
+        _obs.instant("admit", cat="serve",
+                     args={"rid": rid, "slot": slot,
+                           "prefix_matched": int(matched)})
+        ps["pending"] = {"slot": slot, "rid": rid,
+                         "prompt": np.asarray(prompt).reshape(-1)
+                         .astype(np.int64), "cap": cap,
+                         "prio": entry["prio"], "pos": int(matched)}
+        return ps["pending"]
+
+    def _try_restore(self, ps, entry):
+        """Admit a :class:`~mxnet_tpu.serve.swap.SwappedRequest` by
+        restoring its pages: reserve through the normal gate, allocate
+        fresh pages at the SAME ring positions, scatter the saved
+        contents back (one traced install program), splice lens/tok.
+        Zero prefill, zero retraces; bit-parity with the pre-swap pool
+        (``_verify_restore`` re-extracts and asserts it in tests)."""
+        import jax.numpy as jnp
+
+        pred = self._pred
+        mgr = pred._manager
+        rec = entry["swap"]
+        m = mgr.pages_per_slot
+        remaining = max(rec.cap - len(rec.delivered), 0)
+        total = rec.lens + remaining + self._spec_k + 1
+        target = min(-(-min(total, pred.cache_len)
+                       // mgr.page_tokens), m)
+        # a record that re-publishes its prompt chain AND will wrap must
+        # budget one fork per prompt page up front (the gate's
+        # budget_wrap_forks rule): a later request may map the published
+        # pages, turning the wrap recycle into a copy-on-write fork
+        fork = -(-rec.prompt.size // mgr.page_tokens) \
+            if rec.publish and total > pred.cache_len else 0
+        need = rec.n_pages + max(target - rec.n_pages, 0) + fork
+        if not mgr.gate_pages(need):
+            return None
+        self._queue.popleft()
+        slot = next(s for s in range(self._slots)
+                    if s not in ps["active"])
+        row = mgr.restore_slot(slot, rec.row_valid, need)
+        state = ps["state"]
+        caches = pred.install_pages(state.caches, row, rec.data)
+        lens2, tok2 = pred._commit_fn(
+            state.lens, state.tok, np.int32(slot),
+            jnp.asarray([rec.lens], jnp.int32),
+            jnp.asarray([[rec.tok]], jnp.int32))
+        ps["state"] = DecodeState(caches, lens2, tok2)
+        if self._verify_restore:
+            back = pred.extract_pages(ps["state"].caches, row)
+            import jax.tree_util as jtu
+
+            for a, b in zip(jtu.tree_leaves(back),
+                            jtu.tree_leaves(rec.data)):
+                assert np.array_equal(
+                    np.asarray(a)[rec.row_valid],
+                    np.asarray(b)[rec.row_valid]), \
+                    "restored pages are not bit-identical"
+        if rec.publish:
+            mgr.publish(slot, rec.prompt, rec.prompt.size)
+        if self._proposer is not None \
+                and getattr(self._proposer, "needs_prefill", False):
+            import jax
+
+            ps["key"], sub = jax.random.split(ps["key"])
+            self._proposer.admit(
+                _pad_window(rec.prompt, self._max_prefill),
+                rec.prompt.size, slot, self._slots, sub)
+        rid = entry["rid"]
+        req = self._req[rid]
+        req["admit"] = time.time()
+        if rec.first_ts is not None:
+            req["first"] = rec.first_ts
+        else:
+            req["first"] = req["admit"]
+        ps["active"][slot] = {"rid": rid, "toks": list(rec.delivered),
+                              "cap": rec.cap, "prio": rec.priority,
+                              "prompt": rec.prompt}
+        ps["histories"][slot] = list(rec.history)
+        ps["slot_lens"][slot] = rec.lens
+        ps["act_mask"][slot] = 1
+        if rec.kind == "swap":
+            self.swap_ins += 1
+        else:
+            self._m_migrated_pages.inc(rec.n_pages)
+        _obs.instant("swap_in" if rec.kind == "swap" else "page_migrate",
+                     cat="serve", args={"rid": rid, "slot": slot,
+                                        "pages": rec.n_pages})
+        self._retire_finished(ps["active"], ps["results"],
+                              self._on_retire_paged(ps))
+        return True
+
+    def _swap_out(self, ps, slot):
+        """Preempt ``slot``: extract its pages to host RAM (one traced
+        program), free them, and hand the restorable record to the
+        fleet's preemption callback — or re-queue it locally at the
+        back, so the blocked waiter admits and the victim resumes
+        later.  Returns the record."""
+        from .serve.swap import SwappedRequest
+
+        pred = self._pred
+        mgr = pred._manager
+        rec = ps["active"][slot]
+        row = mgr.tables[slot].copy()
+        valid = row != 0
+        data = pred.extract_pages(ps["state"].caches, row)
+        req = self._req.get(rec["rid"], {})
+        record = SwappedRequest(
+            rec["prompt"], rec["toks"], ps["histories"][slot],
+            rec["cap"], rec["prio"], int(ps["slot_lens"][slot]),
+            int(np.asarray(ps["state"].tok)[slot, 0]),
+            valid, data, kind="swap",
+            submit_ts=req.get("submit"), first_ts=req.get("first"),
+            rid=rec["rid"])
+        mgr.free_slot(slot)
+        ps["act_mask"][slot] = 0
+        ps["slot_lens"][slot] = 0
+        del ps["active"][slot]
+        del ps["histories"][slot]
+        self.swap_outs += 1
+        self._m_swapped_pages.inc(record.n_pages)
+        _obs.instant("swap_out", cat="serve",
+                     args={"rid": record.rid, "slot": int(slot),
+                           "pages": record.n_pages})
+        if self._preempt_cb is not None:
+            # the SLO record travels WITH the record (submit/first ts);
+            # the readmitting host creates its own — drop ours or a
+            # fleet host under preemption churn leaks one _req entry
+            # per swap-out forever (never retired, never pruned)
+            self._req.pop(record.rid, None)
+            self._preempt_cb(record)
+        else:
+            self._queue.append({"rid": record.rid,
+                                "prompt": record.prompt,
+                                "cap": record.cap,
+                                "prio": record.priority,
+                                "swap": record})
+        return record
+
+    def _preempt_for_waiter(self, ps, allow_bound):
+        """ONE copy of the preemption rule, for both blocking modes
+        (slot-full and pool-gate-blocked): the queue head evicts the
+        lowest-priority (then longest-running) slot when it strictly
+        outranks it — or, with ``allow_bound``, when the fair-admission
+        bound has been exceeded.  Swaps, re-admits, resets the blocked
+        counter on success; returns the re-admission result (None = no
+        preemption or still blocked)."""
+        active = ps["active"]
+        if not (self._swap_armed and active and self._queue):
+            return None
+        victim = min(active,
+                     key=lambda s: (active[s]["prio"],
+                                    -int(ps["slot_lens"][s])))
+        bound_hit = allow_bound and self._fair_bound > 0 \
+            and ps["blocked"] >= self._fair_bound
+        if active[victim]["prio"] >= self._queue[0]["prio"] \
+                and not bound_hit:
+            return None
+        self._swap_out(ps, victim)
+        got = self._admit_one(ps)
+        # one swap per bound window: the counter restarts even when the
+        # waiter is still blocked, so preemption cannot cascade through
+        # every resident in consecutive ticks
+        ps["blocked"] = 0
+        return got
+
+    def serve_tick(self):
+        """ONE iteration of the paged serving schedule.
+
+        (1) gate at most one queued request through the page allocator —
+        reservation failure is BACKPRESSURE, the request stays queued
+        until retirements free pages; fair admission: after
+        ``MXNET_FLEET_DECODE_BOUND`` consecutive gate-blocked decode
+        iterations the lowest-priority (then longest) slot is preempted
+        to host RAM (``MXNET_FLEET_SWAP``), so a long decode can no
+        longer wedge the admission gate; (2) advance the in-flight
+        admission by ONE prefill chunk (prefix-cache-matched pages were
+        mapped at the gate, only the tail computes), so a long prompt
+        interleaves with decode instead of stalling the batch; (3) on
+        the final chunk, splice the first token/length into the batch
+        state, publish the prompt's pages to the prefix cache and
         activate the slot; (4) retire finished requests — freeing their
         pages IMMEDIATELY, EOS-mid-speculation-window included; (5) run
         one decode (or speculative verify) step over the active slots,
         inactive rows masked.  Every device program here was traced
-        once — page tables, active masks, slot indices and page ids are
-        all data.
+        once — page tables, active masks, slot indices, page ids and
+        swapped page contents are all data.
         """
         import jax
         import jax.numpy as jnp
 
         pred = self._pred
-        key = jax.random.PRNGKey(self._seed)
-        slots = self._slots
-        state = pred.paged_batch_state(slots)
+        ps = self.serve_open()
         mgr = pred._manager
-        active = {}     # slot -> [rid, tokens list, max_new]
-        results = {}
-        histories = {}
-        slot_lens = np.zeros(slots, np.int64)
-        act_mask = np.zeros(slots, np.int32)
-        pending = None  # the one admission mid-chunked-prefill
+        slots = self._slots
+        greedy = pred._greedy
+
+        def next_key():
+            # greedy sampling never reads the key: skip the per-tick
+            # split dispatches (a measurable slice of small-batch serve)
+            if greedy:
+                return pred._zero_key
+            ps["key"], sub = jax.random.split(ps["key"])
+            return sub
+
+        active = ps["active"]
+        histories = ps["histories"]
+        slot_lens = ps["slot_lens"]
+        act_mask = ps["act_mask"]
         proposer = self._proposer
         k = self._spec_k
-        limit = pred.cache_len
-        if proposer is not None and getattr(proposer, "cache_len", None):
-            limit = min(limit, proposer.cache_len + 1)
-
-        def on_retire(slot):
-            act_mask[slot] = 0
-            # pages back to the pool NOW — the very next admission
-            # gate sees them (not "at next admission")
-            mgr.free_slot(slot)
+        limit = self._paged_limit()
+        on_retire = self._on_retire_paged(ps)
 
         def retire():
-            self._retire_finished(active, results, on_retire)
+            self._retire_finished(active, ps["results"], on_retire)
 
         deliver = self._deliver
 
-        def try_admit():
-            rid, prompt, cap = self._queue[0]
-            gate = mgr.gate(prompt, prompt.size, cap, k)
-            if gate is None:
-                return None
-            self._queue.popleft()
-            matched, pages, reserve_n = gate
-            slot = next(s for s in range(slots) if s not in active)
-            mgr.map_slot(slot, pages, reserve_n)
-            self._req[rid]["admit"] = time.time()
-            _obs.instant("admit", cat="serve",
-                         args={"rid": rid, "slot": slot,
-                               "prefix_matched": int(matched)})
-            return {"slot": slot, "rid": rid,
-                    "prompt": np.asarray(prompt).reshape(-1)
-                    .astype(np.int64), "cap": cap, "pos": int(matched)}
-
-        while self._queue or active or pending:
-            # --- (1) admission gate: one request starts prefilling
-            if pending is None and self._queue and len(active) < slots:
-                pending = try_admit()
-                if pending is None and not active:
+        # --- (1a) slot-full priority preemption: a waiter that OUTRANKS
+        # the lowest-priority resident evicts it even when the block is
+        # slots, not pages — priority scheduling; equal priorities keep
+        # the classic wait-for-retirement behavior
+        if ps["pending"] is None and len(active) >= slots:
+            self._preempt_for_waiter(ps, allow_bound=False)
+        # --- (1) admission gate: one request starts (or restores)
+        if ps["pending"] is None and self._queue and len(active) < slots:
+            got = self._admit_one(ps)
+            if got is None:
+                ps["blocked"] += 1
+                if not active:
                     # nothing running to free pages: spill the whole
                     # prefix cache, then the pool is genuinely too small
                     if mgr.prefix_cache is not None:
                         mgr.prefix_cache.evict(mgr.pool_pages)
-                        pending = try_admit()
-                    if pending is None:
+                        got = self._admit_one(ps)
+                    if got is None:
                         raise MXNetError(
                             "KV page pool (%d pages) cannot admit a "
                             "%d-token request even with an empty batch — "
                             "raise MXNET_KV_POOL_PAGES"
-                            % (mgr.pool_pages, self._queue[0][1].size))
-            # --- (2) one prefill chunk of the in-flight admission
-            if pending is not None:
-                p = pending
-                n = min(self._chunk_w, p["prompt"].size - p["pos"])
-                copies = mgr.ensure(p["slot"], p["pos"], p["pos"] + n)
-                caches = pred._run_forks(state.caches, copies) \
-                    if copies else state.caches
-                key, sub = jax.random.split(key)
-                _obs.instant("prefill_chunk", cat="serve",
-                             args={"slot": p["slot"], "pos": p["pos"],
-                                   "tokens": int(n)})
-                with _obs.program_span("prefill"):
-                    caches, probs, tok = pred._chunk_fn(
-                        pred._env, caches,
-                        jnp.asarray(mgr.tables[p["slot"]:p["slot"] + 1]),
-                        jnp.asarray(_pad_window(
-                            p["prompt"][p["pos"]:p["pos"] + n],
-                            self._chunk_w)),
-                        jnp.asarray([p["pos"]], jnp.int32),
-                        jnp.asarray([n], jnp.int32), sub)
-                state = DecodeState(caches, state.lens, state.tok)
-                p["pos"] += n
-                pred._chunk_widths.add(self._chunk_w)
-                if p["pos"] >= p["prompt"].size:
-                    # --- (3) commit: the slot joins the batch
-                    slot, plen = p["slot"], p["prompt"].size
-                    first = int(np.asarray(tok)[0, 0])
-                    lens2, tok2 = pred._commit_fn(
-                        state.lens, state.tok, np.int32(slot),
-                        jnp.asarray([plen], jnp.int32), tok)
-                    state = DecodeState(state.caches, lens2, tok2)
-                    mgr.publish(slot, p["prompt"], plen)
-                    if proposer is not None \
-                            and getattr(proposer, "needs_prefill", False):
-                        key, sub = jax.random.split(key)
-                        proposer.admit(
-                            _pad_window(p["prompt"], self._max_prefill),
-                            plen, slot, slots, sub)
-                    active[slot] = [p["rid"], [first], p["cap"]]
-                    histories[slot] = list(p["prompt"]) + [first]
-                    slot_lens[slot] = plen
-                    act_mask[slot] = 1
-                    self._req[p["rid"]]["first"] = time.time()
-                    pending = None
-                    retire()        # a first-token EOS / cap-1 request
-            if not active:
-                continue
-            # --- (5) one decode / verify step over the active slots
-            key, sub = jax.random.split(key)
-            can_spec = proposer is not None and k > 0 and pending is None \
-                and max(slot_lens[s] for s in active) + k + 1 <= limit
-            if can_spec:
-                hists = [histories.get(s) or [0] for s in range(slots)]
-                draft_toks, draft_probs = proposer.propose(
-                    hists, state, slot_lens, sub)
-                key, sub = jax.random.split(key)
-                state, out, counts = pred.paged_verify(
-                    state, slot_lens, draft_toks, draft_probs, sub,
-                    act_mask)
-                out_h = np.asarray(out)
-                counts_h = np.asarray(counts).astype(np.int64)
-                self._note_step(spec=True)
-                for slot, rec in active.items():
-                    emitted = out_h[slot, :counts_h[slot]]
-                    self._note_accept(k, int(counts_h[slot]) - 1)
-                    deliver(rec, emitted)
-                    histories[slot].extend(int(t) for t in emitted)
-                slot_lens += counts_h
-            else:
-                state, _ = pred.paged_step(state, slot_lens, sub, act_mask)
-                self._note_step()
-                toks = np.asarray(state.tok)[:, 0]
-                for slot, rec in active.items():
-                    deliver(rec, toks[slot:slot + 1])
-                    histories[slot].append(int(toks[slot]))
-                slot_lens += act_mask.astype(np.int64)
-            retire()
-        return results
+                            % (mgr.pool_pages,
+                               self._queue[0]["prompt"].size))
+                else:
+                    # pool-gate preemption: a HIGHER-priority waiter
+                    # evicts immediately; any waiter evicts the
+                    # lowest-priority slot once the gate has blocked
+                    # MXNET_FLEET_DECODE_BOUND consecutive iterations.
+                    # The waiter admits on the freed pages and the
+                    # victim resumes bit-exactly
+                    got = self._preempt_for_waiter(ps, allow_bound=True)
+            if got is not None:
+                ps["blocked"] = 0
+        # --- (2) one prefill chunk of the in-flight admission
+        if ps["pending"] is not None:
+            p = ps["pending"]
+            state = ps["state"]
+            n = min(self._chunk_w, p["prompt"].size - p["pos"])
+            copies = mgr.ensure(p["slot"], p["pos"], p["pos"] + n)
+            caches = pred._run_forks(state.caches, copies) \
+                if copies else state.caches
+            sub = next_key()
+            _obs.instant("prefill_chunk", cat="serve",
+                         args={"slot": p["slot"], "pos": p["pos"],
+                               "tokens": int(n)})
+            with _obs.program_span("prefill"):
+                caches, probs, tok = pred._chunk_fn(
+                    pred._env, caches,
+                    jnp.asarray(mgr.tables[p["slot"]:p["slot"] + 1]),
+                    jnp.asarray(_pad_window(
+                        p["prompt"][p["pos"]:p["pos"] + n],
+                        self._chunk_w)),
+                    jnp.asarray([p["pos"]], jnp.int32),
+                    jnp.asarray([n], jnp.int32), sub)
+            ps["state"] = state = DecodeState(caches, state.lens,
+                                              state.tok)
+            p["pos"] += n
+            pred._chunk_widths.add(self._chunk_w)
+            if p["pos"] >= p["prompt"].size:
+                # --- (3) commit: the slot joins the batch
+                slot, plen = p["slot"], p["prompt"].size
+                first = int(np.asarray(tok)[0, 0])
+                lens2, tok2 = pred._commit_fn(
+                    state.lens, state.tok, np.int32(slot),
+                    jnp.asarray([plen], jnp.int32), tok)
+                ps["state"] = DecodeState(state.caches, lens2, tok2)
+                mgr.publish(slot, p["prompt"], plen)
+                if proposer is not None \
+                        and getattr(proposer, "needs_prefill", False):
+                    ps["key"], sub = jax.random.split(ps["key"])
+                    proposer.admit(
+                        _pad_window(p["prompt"], self._max_prefill),
+                        plen, slot, slots, sub)
+                active[slot] = {"rid": p["rid"], "toks": [first],
+                                "cap": p["cap"], "prio": p["prio"],
+                                "prompt": p["prompt"]}
+                histories[slot] = list(p["prompt"]) + [first]
+                slot_lens[slot] = plen
+                act_mask[slot] = 1
+                self._req[p["rid"]]["first"] = time.time()
+                ps["pending"] = None
+                retire()        # a first-token EOS / cap-1 request
+        self._note_gauges()
+        if not active:
+            return
+        # --- (5) one decode / verify step over the active slots
+        sub = next_key()
+        can_spec = proposer is not None and k > 0 \
+            and ps["pending"] is None \
+            and max(slot_lens[s] for s in active) + k + 1 <= limit
+        if can_spec:
+            hists = [histories.get(s) or [0] for s in range(slots)]
+            draft_toks, draft_probs = proposer.propose(
+                hists, ps["state"], slot_lens, sub)
+            sub = next_key()
+            state, out, counts = pred.paged_verify(
+                ps["state"], slot_lens, draft_toks, draft_probs, sub,
+                act_mask)
+            ps["state"] = state
+            out_h = np.asarray(out)
+            counts_h = np.asarray(counts).astype(np.int64)
+            self._note_step(spec=True)
+            for slot, rec in active.items():
+                emitted = out_h[slot, :counts_h[slot]]
+                self._note_accept(k, int(counts_h[slot]) - 1)
+                deliver(rec, emitted)
+                histories[slot].extend(int(t) for t in emitted)
+            slot_lens += counts_h
+        else:
+            state, _ = pred.paged_step(ps["state"], slot_lens, sub,
+                                       act_mask)
+            ps["state"] = state
+            toks = np.asarray(state.tok)[:, 0]
+            self._note_step()
+            for slot, rec in active.items():
+                deliver(rec, toks[slot:slot + 1])
+                histories[slot].append(int(toks[slot]))
+            slot_lens += act_mask.astype(np.int64)
+        retire()
+
+    def _note_gauges(self):
+        """Refresh the per-host queue-depth / free-page gauges (the
+        router's load + headroom signals)."""
+        self._m_queue_depth.set(len(self._queue))
+        mgr = getattr(self._pred, "_manager", None)
+        if mgr is not None:
+            self._m_free_pages.set(mgr.allocator.free_pages)

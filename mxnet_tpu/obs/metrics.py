@@ -126,6 +126,27 @@ class _Metric:
         with self._lock:
             return list(self._series.items())
 
+    def reset_series(self, *values, **kv):
+        """Zero ONE labeled child series (other labels untouched) — how
+        a fleet router cold-starts its own hosts' TTFT samples between
+        timed drains without clearing other hosts' history.  No-op when
+        the series does not exist yet."""
+        if kv:
+            if values:
+                raise ValueError("pass labels positionally or by keyword, "
+                                 "not both")
+            values = tuple(kv[n] for n in self.label_names)
+        values = tuple(str(v) for v in values)
+        with self._lock:
+            old = self._series.get(values)
+            if old is None:
+                return
+            # zero IN PLACE: bound children (DecodeServer holds one per
+            # host label) must keep recording into the same series
+            fresh = self._new_series()
+            for slot in old.__slots__:
+                setattr(old, slot, getattr(fresh, slot))
+
 
 class _CounterSeries:
     __slots__ = ("value",)

@@ -10,7 +10,11 @@ Endpoints:
 
 * ``/metrics``       — Prometheus text exposition
   (:meth:`MetricsRegistry.prometheus_text`);
-* ``/metrics.json``  — the registry snapshot as JSON;
+* ``/metrics.json``  — the registry snapshot as JSON, merged with any
+  registered JSON providers (:meth:`MetricsServer.add_json`) — e.g. the
+  serving loop's per-host ``mx_serve_summary:<host>`` routing views
+  (prefix-cache chain digest + free-page/queue-depth signals) the
+  fleet router polls;
 * ``/trace``         — the current trace-timeline ring as Chrome-trace
   JSON (save it, open in Perfetto);
 * ``/healthz``       — liveness probe (``ok``).
@@ -46,6 +50,23 @@ class MetricsServer:
         self._port = int(port)
         self._httpd = None
         self._thread = None
+        self._json = {}     # extra /metrics.json sections: name -> fn
+
+    def add_json(self, name, provider):
+        """Merge ``provider()`` (a JSON-serializable dict) into the
+        ``/metrics.json`` payload under ``name`` — how the serving loop
+        exposes non-scalar state (the prefix-cache chain summary) next
+        to the registry snapshot.  Re-registering a name replaces it;
+        servers sharing one port therefore register DISTINCT names
+        (``mx_serve_summary:<host>``)."""
+        self._json[str(name)] = provider
+        return self
+
+    def remove_json(self, name):
+        """Drop a registered ``/metrics.json`` section (a renamed host
+        re-registers under its new label)."""
+        self._json.pop(str(name), None)
+        return self
 
     @property
     def port(self):
@@ -60,6 +81,7 @@ class MetricsServer:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         registry, timeline = self.registry, self.timeline
+        extra_json = self._json
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
@@ -68,7 +90,13 @@ class MetricsServer:
                     body = registry.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
                 elif path == "/metrics.json":
-                    body = json.dumps(registry.snapshot()).encode()
+                    payload = registry.snapshot()
+                    for name, fn in list(extra_json.items()):
+                        try:
+                            payload[name] = fn()
+                        except Exception as exc:  # a dead provider must
+                            payload[name] = {"error": str(exc)}  # not 500
+                    body = json.dumps(payload).encode()
                     ctype = "application/json"
                 elif path == "/trace" and timeline is not None:
                     body = json.dumps(timeline.export()).encode()

@@ -28,11 +28,34 @@ half of the paged plan (``MXNET_KV_PAGED``; the device kernels live in
 ``decode.DecodePredictor(paged=True)`` and ``decode.DecodeServer`` drive
 all three; nothing here touches jax — the manager only *decides* and the
 decode layer executes the resulting fork/append plans on device.
+
+Above the single host sit the fleet layers (docs/serving_fleet.md):
+
+* :mod:`~mxnet_tpu.serve.swap` — restorable page records: preemption
+  swap-out to host RAM and the page-migration wire format of
+  prefill/decode disaggregation (one extract + one install program,
+  page ids as data — zero retraces);
+* :mod:`~mxnet_tpu.serve.fleet` — the front-end :class:`Router` over N
+  per-host ``DecodeServer``\\ s: cache-aware routing on prefix-chain
+  summaries, dedicated :class:`PrefillWorker`\\ s shipping committed
+  pages DistServe-style, and preemption rehoming.
 """
 from __future__ import annotations
 
 from .allocator import PageAllocator
-from .prefix_cache import PrefixCache
+from .prefix_cache import PrefixCache, chain_hash
 from .manager import PagedKVManager
+from .swap import SwapStore, SwappedRequest
 
-__all__ = ["PageAllocator", "PrefixCache", "PagedKVManager"]
+__all__ = ["PageAllocator", "PrefixCache", "PagedKVManager",
+           "SwapStore", "SwappedRequest", "chain_hash"]
+
+
+def __getattr__(name):
+    # fleet imports obs (and through it config/metrics); keep the base
+    # package import light by resolving the router layer lazily
+    if name in ("FleetHost", "PrefillWorker", "Router", "match_chains"):
+        from . import fleet
+
+        return getattr(fleet, name)
+    raise AttributeError(name)

@@ -1,0 +1,434 @@
+"""Disaggregated serving fleet — cache-aware routing, prefill/decode
+split, and preemption-aware readmission over N per-host ``DecodeServer``s.
+
+One host's serving loop is done (paged + speculative + continuous-batched
++ SLO-instrumented); this module is the millions-of-users rung: a
+front-end :class:`Router` in the KVStore tradition (the reference's
+``ps-lite`` Postoffice role — ``kvstore_server.py`` is the in-repo
+heritage: a front-end process mediating N workers), three policies deep:
+
+* **Cache-aware routing.**  Every host exposes a routing view
+  (``DecodeServer.serve_summary`` — served remotely inside
+  ``/metrics.json``, read directly in-process): free-page and
+  queue-depth load signals plus the prefix-cache **chain summary**
+  (content-free token-chain hashes,
+  :meth:`~mxnet_tpu.serve.prefix_cache.PrefixCache.summary`).  The
+  router replays the same hashes over an incoming prompt
+  (:func:`match_chains`) and routes to the host with the LONGEST cached
+  chain, tie-broken by load — shared-prefix traffic lands where its
+  pages already live and prefills only the tail.  ``round_robin`` is
+  the A/B baseline policy (``benchmarks/bench_fleet.py`` measures the
+  delta on a bursty multi-tenant trace).
+* **Prefill/decode disaggregation** (DistServe; Zhong et al., OSDI
+  2024).  Prompts too cold to ride a cache match (below
+  ``MXNET_FLEET_PREFILL_THRESHOLD``) go to a dedicated
+  :class:`PrefillWorker`, which runs the SAME chunked-prefill program
+  into its own pool and ships the committed pages — quantized data +
+  per-(token, head) scales + chain keys — as a
+  :class:`~mxnet_tpu.serve.swap.SwappedRequest` record.  The target
+  decode host admits it through the normal
+  :meth:`~mxnet_tpu.serve.manager.PagedKVManager.gate_pages`
+  reservation gate and installs the pages with one traced scatter
+  (page ids are DATA — zero retraces on either end), then publishes the
+  chain keys so later prompts match the migrated prefix.
+* **Preemption/swap.**  When a host's pool wedges
+  (``MXNET_FLEET_SWAP`` + ``MXNET_FLEET_DECODE_BOUND``), the victim's
+  record lands back at the router (``_preempt_cb``) and readmits on the
+  least-loaded ALIVE host — swap and migration are one mechanism, so a
+  fleet drains around a wedged pool instead of stalling admission
+  fleet-wide.
+
+Dead hosts (``FleetHost.alive = False`` — set by an operator or a
+failed health poll) are skipped by routing and ticking; see
+docs/serving_fleet.md for the failure matrix.  Everything
+here is host-side numpy + the serve/swap records; device work happens
+inside the per-host serving loops.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import obs as _obs
+from .prefix_cache import chain_hash
+from .swap import SwapStore
+
+__all__ = ["FleetHost", "PrefillWorker", "Router", "match_chains"]
+
+
+def match_chains(prompt, chains):
+    """Estimated cached-chain coverage of ``prompt`` on a host, from its
+    content-free chain summary (:meth:`PrefixCache.summary`): walk full
+    pages by prefix hash, then the longest exactly-matching partial
+    entry.  Page-granular plus exact partials — the host's token-level
+    radix matching can only do better, so the estimate is a safe lower
+    bound.  Capped at ``len(prompt) - 1`` like the cache itself."""
+    toks = np.asarray(prompt, np.int64).reshape(-1)
+    if not chains or toks.size == 0:
+        return 0
+    pt = int(chains["page_tokens"])
+    cap = toks.size - 1
+    full = set(chains.get("full") or ())
+    n = 0
+    while (n + 1) * pt <= toks.size \
+            and chain_hash(toks[:(n + 1) * pt]) in full:
+        n += 1
+    matched = n * pt
+    rest = toks[matched:]
+    ph = chain_hash(toks[:matched])
+    best = 0
+    for part in chains.get("partial") or ():
+        ln = int(part["len"])
+        if part["prefix"] == ph and best < ln <= rest.size \
+                and chain_hash(rest[:ln]) == part["hash"]:
+            best = ln
+    return min(matched + best, cap)
+
+
+class FleetHost:
+    """One decode host: a named paged :class:`~mxnet_tpu.decode.
+    DecodeServer` plus liveness.  ``summary()`` is the router's poll —
+    in-process it reads the server directly; a remote router reads the
+    identical payload from the host's ``/metrics.json``
+    (``mx_serve_summary``)."""
+
+    def __init__(self, name, server):
+        self.name = str(name)
+        self.server = server
+        self.alive = True
+        server._bind_host_metrics(self.name)
+
+    def summary(self):
+        return self.server.serve_summary()
+
+    def load(self, summary=None):
+        """Queued + in-flight requests — the routing tie-breaker."""
+        s = summary or self.summary()
+        return int(s["active"]) + int(s["queue_depth"])
+
+
+class PrefillWorker:
+    """A dedicated prefill host (DistServe's prefill instance): runs
+    chunked prefill into its OWN page pool and emits the committed
+    prompt state as a migratable record.  The worker keeps a prefix
+    cache too, so a shared-prefix burst that routes cold pays the
+    prefix once per WORKER, not once per request."""
+
+    def __init__(self, predictor, name="prefill0"):
+        if not getattr(predictor, "_paged", False):
+            raise MXNetError("PrefillWorker needs a paged DecodePredictor")
+        self._pred = predictor
+        self.name = str(name)
+        self._state = None
+        self.prefills = 0
+
+    def reset(self):
+        """Fresh pool + prefix cache (compiled programs survive)."""
+        self._state = None
+
+    def prefill(self, prompt, cap, priority=0, submit_ts=None, key=None):
+        """Run one prompt's chunked prefill; returns the ``migrate``
+        record (pages + scales + chain keys + first token) ready for
+        :meth:`DecodeServer.inject` on any decode host."""
+        import jax
+
+        from ..decode import DecodeState
+        from .swap import SwappedRequest
+
+        pred = self._pred
+        if self._state is None:
+            self._state = pred.paged_batch_state(1)
+        mgr = pred._manager
+        prompt = np.asarray(prompt).reshape(-1).astype(np.int64)
+        gate = mgr.gate(prompt, prompt.size, 0, budget_wrap_forks=False)
+        if gate is None and mgr.prefix_cache is not None:
+            mgr.prefix_cache.evict(mgr.pool_pages)
+            gate = mgr.gate(prompt, prompt.size, 0,
+                            budget_wrap_forks=False)
+        if gate is None:
+            raise MXNetError(
+                "prefill worker pool (%d pages) cannot hold a %d-token "
+                "prompt — raise its pool_pages" % (mgr.pool_pages,
+                                                   prompt.size))
+        matched, pages, reserve_n = gate
+        mgr.map_slot(0, pages, reserve_n)
+        caches, tok, _ = pred._chunked_fill(
+            self._state.caches, 0, prompt, matched,
+            key if key is not None else jax.random.PRNGKey(0))
+        self._state = DecodeState(caches, self._state.lens,
+                                  self._state.tok)
+        mgr.publish(0, prompt, prompt.size)
+        row = mgr.tables[0].copy()
+        first = int(np.asarray(tok)[0, 0])
+        data = pred.extract_pages(self._state.caches, row)
+        record = SwappedRequest(
+            prompt, [first], list(prompt) + [first], cap, priority,
+            lens=prompt.size, tok=first, row_valid=row != 0, data=data,
+            kind="migrate", publish=True, submit_ts=submit_ts,
+            first_ts=time.time())
+        mgr.free_slot(0)
+        self.prefills += 1
+        return record
+
+
+class Router:
+    """Front-end over N :class:`FleetHost`\\ s (+ optional
+    :class:`PrefillWorker`\\ s).
+
+    ``submit`` queues; ``tick`` routes pending requests and advances
+    every live host by one serving iteration; ``drain`` loops to
+    completion and returns ``{router_rid: np.int32 tokens}``.  Policies:
+    ``cache_aware`` (longest chain match, load tie-break, dead-host
+    skip; disaggregates cold prompts through the prefill workers) and
+    ``round_robin`` (the monolithic baseline — next live host, no
+    disaggregation).  Preempted records re-enter here and readmit on
+    the least-loaded live host (restore is host-agnostic — pages are
+    raw pool bytes).
+    """
+
+    def __init__(self, hosts, prefill_workers=(), policy="cache_aware",
+                 threshold=None):
+        from .. import config as _config
+
+        if policy not in ("cache_aware", "round_robin"):
+            raise MXNetError("unknown routing policy %r" % (policy,))
+        self.hosts = list(hosts)
+        if not self.hosts:
+            raise MXNetError("Router needs at least one host")
+        self.workers = list(prefill_workers)
+        self.policy = policy
+        self._threshold = float(
+            _config.get("MXNET_FLEET_PREFILL_THRESHOLD")
+            if threshold is None else threshold)
+        self._queue = deque()       # unrouted submissions
+        self._restores = deque()    # preempted records awaiting rehoming
+        self.swap_store = SwapStore()   # host-RAM bill of parked records
+        self._next_rid = 0
+        self._rr = 0                # round-robin cursor
+        self._wrr = 0               # worker cursor
+        self._affinity = {}         # first-page chain hash -> host name
+        self._map = {}              # (host_name, host_rid) -> router rid
+        self.results = {}
+        self.decisions = []         # (rid, host, matched_est, path)
+        self._m_routed = _obs.registry.counter(
+            "mx_fleet_routed", "requests routed to a decode host",
+            labels=("host",))
+        self._m_matched = _obs.registry.counter(
+            "mx_fleet_router_matched_tokens",
+            "prompt tokens the routing-time chain match covered")
+        self._m_lookup = _obs.registry.counter(
+            "mx_fleet_router_lookup_tokens",
+            "prompt tokens scored by the router")
+        self._base_matched = self._m_matched.get()
+        self._base_lookup = self._m_lookup.get()
+        for host in self.hosts:
+            host.server._preempt_cb = \
+                lambda record, h=host: self._on_preempt(h, record)
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, priority=0):
+        """Queue a prompt with the fleet; returns the router-level rid."""
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append({"rid": rid,
+                            "prompt": np.asarray(prompt).reshape(-1),
+                            "cap": max_new_tokens, "prio": int(priority),
+                            "submit": time.time()})
+        return rid
+
+    def _alive(self):
+        hosts = [h for h in self.hosts if h.alive]
+        if not hosts:
+            raise MXNetError("no live decode hosts")
+        return hosts
+
+    def _on_preempt(self, host, record):
+        self.swap_store.put(record, key=(host.name, record.rid))
+        self._restores.append((host.name, record))
+
+    # ------------------------------------------------------------------
+    def _score(self, prompt, summaries):
+        """(host, summary, matched-token estimate) per live host."""
+        out = []
+        for host, s in summaries:
+            out.append((host, s, match_chains(prompt, s["chains"])))
+        return out
+
+    def route(self, entry):
+        """Route ONE submission: pick the host (and the prefill path)
+        under the active policy and dispatch it.  Returns the chosen
+        :class:`FleetHost`."""
+        alive = self._alive()
+        prompt = entry["prompt"]
+        if self.policy == "round_robin":
+            host = alive[self._rr % len(alive)]
+            self._rr += 1
+            matched, path = 0, "direct"
+        else:
+            summaries = [(h, h.summary()) for h in alive]
+            scored = self._score(prompt, summaries)
+            best = max(s[2] for s in scored)
+            self._m_lookup.inc(max(prompt.size - 1, 0))
+            self._m_matched.inc(best)
+            matched = best
+            if best > 0:
+                # longest chain wins; load breaks ties
+                host = max(scored,
+                           key=lambda s: (s[2], -s[0].load(s[1])))[0]
+            else:
+                # nothing cached anywhere yet: STICKY affinity by the
+                # prompt's first-page chain hash — the first sighting of
+                # a chain binds it to the least-loaded live host, and
+                # every later cold request of the same chain follows, so
+                # a cold burst of one tenant co-locates (its second
+                # request finds the first one's pages) while distinct
+                # tenants spread by load instead of hash luck
+                pt = int(getattr(alive[0].server._pred, "_page_tokens",
+                                 0) or 16)
+                head = chain_hash(np.asarray(prompt, np.int64)[:pt])
+                bound = self._affinity.get(head)
+                host = next((h for h in alive if h.name == bound), None)
+                if host is None:
+                    host = min(scored,
+                               key=lambda s: s[0].load(s[1]))[0]
+                    self._affinity[head] = host.name
+            path = "prefill_worker" if self.workers \
+                and best < self._threshold * prompt.size else "direct"
+        if path == "prefill_worker":
+            worker = self.workers[self._wrr % len(self.workers)]
+            self._wrr += 1
+            record = worker.prefill(prompt, entry["cap"]
+                                    if entry["cap"] is not None
+                                    else host.server._max_new,
+                                    priority=entry["prio"],
+                                    submit_ts=entry["submit"])
+            hrid = host.server.inject(record)
+        else:
+            hrid = host.server.submit(prompt, entry["cap"],
+                                      priority=entry["prio"])
+            host.server._req[hrid]["submit"] = entry["submit"]
+        self._map[(host.name, hrid)] = entry["rid"]
+        self._m_routed.labels(host=host.name).inc()
+        self.decisions.append((entry["rid"], host.name, int(matched),
+                               path))
+        _obs.instant("route", cat="fleet",
+                     args={"rid": entry["rid"], "host": host.name,
+                           "matched": int(matched), "path": path})
+        return host
+
+    # ------------------------------------------------------------------
+    def tick(self):
+        """One fleet iteration: route every pending submission and
+        preempted record, then advance each live host by one serving
+        iteration and collect finished results."""
+        while self._queue:
+            self.route(self._queue.popleft())
+        while self._restores:
+            src_name, record = self._restores.popleft()
+            # readmit on the least-loaded live host — no prefill, no
+            # cache match needed: pages restore as raw pool bytes
+            host = min(self._alive(), key=lambda h: h.load())
+            rr = self._map.pop((src_name, record.rid), None)
+            self.swap_store.pop((src_name, record.rid))
+            hrid = host.server.inject(record)
+            if rr is not None:
+                self._map[(host.name, hrid)] = rr
+            _obs.instant("rehome", cat="fleet",
+                         args={"from": src_name, "host": host.name,
+                               "pages": record.n_pages})
+        for host in self.hosts:
+            if host.alive and host.server.has_work:
+                host.server.serve_tick()
+                done = host.server.serve_results(clear=True)
+                for hrid, toks in done.items():
+                    rr = self._map.pop((host.name, hrid), None)
+                    if rr is not None:
+                        self.results[rr] = toks
+
+    @property
+    def has_work(self):
+        return bool(self._queue or self._restores
+                    or any(h.alive and h.server.has_work
+                           for h in self.hosts))
+
+    def drain(self):
+        """Tick until the fleet is idle; returns (and keeps) the
+        accumulated ``{router_rid: tokens}``."""
+        while self.has_work:
+            self.tick()
+        return self.results
+
+    def reset(self):
+        """Cold-start every host session and worker pool (fresh pools,
+        managers, prefix caches; compiled programs survive) and clear
+        the router's routing log — the between-drains reset the A/B
+        bench uses."""
+        for host in self.hosts:
+            host.server.serve_reset()
+            host.server._queue.clear()
+        for worker in self.workers:
+            worker.reset()
+        self._queue.clear()
+        self._restores.clear()
+        self._map.clear()
+        self._affinity.clear()
+        self.results = {}
+        self.decisions = []
+        self._base_matched = self._m_matched.get()
+        self._base_lookup = self._m_lookup.get()
+        # cold-start THIS router's TTFT samples too, or stats() after a
+        # timed drain would blend in warmup-compile outliers
+        fam = _obs.registry.get("mx_fleet_ttft")
+        if fam is not None:
+            for host in self.hosts:
+                fam.reset_series(host.name)
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Fleet snapshot derived from the mx_fleet_* registry families
+        (no parallel bookkeeping): per-host routed counts, migrated /
+        swapped pages, aggregate TTFT percentiles over this router's
+        hosts, and the routing-time cache-hit estimate."""
+        reg = _obs.registry
+        names = {h.name for h in self.hosts}
+
+        def per_host(metric):
+            fam = reg.get(metric)
+            out = {}
+            if fam is None:
+                return out
+            for values, s in fam.series():
+                labels = dict(zip(fam.label_names, values))
+                if labels.get("host") in names:
+                    out[labels["host"]] = s.value
+            return out
+
+        ttft = []
+        fam = reg.get("mx_fleet_ttft")
+        if fam is not None:
+            for values, s in fam.series():
+                labels = dict(zip(fam.label_names, values))
+                if labels.get("host") in names:
+                    ttft.extend(s.samples)
+        ttft.sort()
+        lookup = self._m_lookup.get() - self._base_lookup
+        matched = self._m_matched.get() - self._base_matched
+        out = {
+            "policy": self.policy,
+            "hosts": sorted(names),
+            "routed_by_host": per_host("mx_fleet_routed"),
+            "migrated_pages_by_host": per_host("mx_fleet_migrated_pages"),
+            "swapped_pages_by_host": per_host("mx_fleet_swapped_pages"),
+            "swap_outs": sum(h.server.swap_outs for h in self.hosts),
+            "swap_ins": sum(h.server.swap_ins for h in self.hosts),
+            "worker_prefills": sum(w.prefills for w in self.workers),
+            "router_cache_hit_rate": matched / max(lookup, 1),
+            "requests_completed": len(self.results),
+        }
+        if ttft:
+            out["ttft_p50_s"] = float(np.percentile(ttft, 50))
+            out["ttft_p95_s"] = float(np.percentile(ttft, 95))
+        return out

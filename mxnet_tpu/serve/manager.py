@@ -70,6 +70,10 @@ class PagedKVManager:
             if prefix_cache else None
         # 0 = unmapped (the scratch page)
         self.tables = np.zeros((self.slots, self.pages_per_slot), np.int32)
+        # bumped on every table mutation: the decode layer keys its
+        # device-side copy of the tables on it, so steady-state decode
+        # ticks (no page allocated, no fork) re-ship NOTHING
+        self.version = 0
         self._reserve = np.zeros(self.slots, np.int64)
         # True where the slot allocated (or forked) the page itself: the
         # slot's appends land strictly PAST any published/matched
@@ -145,8 +149,41 @@ class PagedKVManager:
             row[i] = page
             self._own[slot, i] = False
         self._reserve[slot] = int(reserve_n)
+        self.version += 1
 
     # ------------------------------------------------------------------
+    def gate_pages(self, need):
+        """Reserve ``need`` pages for a restore (swap-in / migrated
+        prefill) — the SAME admission gate a fresh prompt passes, minus
+        the prefix-cache match (restored pages arrive with their
+        content).  Evicts LRU prefix-cache pages first; False on
+        backpressure (nothing changed)."""
+        need = int(need)
+        if self.allocator.available() < need and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - self.allocator.available())
+        return self.allocator.reserve(need)
+
+    def restore_slot(self, slot, valid, reserve_n):
+        """Bind a restored request to ``slot``: allocate one fresh page
+        per True entry of ``valid`` (a (pages_per_slot,) mask of the
+        saved table row — ring positions matter for wrapped decodes),
+        spending the :meth:`gate_pages` reservation.  All pages are
+        slot-OWNED (refcount 1, private copies), so later appends never
+        fork.  Returns the new table row (0 = unmapped)."""
+        row = self.tables[slot]
+        assert not row.any(), "restoring into a non-empty slot %d" % slot
+        self._reserve[slot] = int(reserve_n)
+        for i in np.flatnonzero(np.asarray(valid).reshape(-1)):
+            self._reserve[slot] -= 1
+            row[i] = self.allocator.alloc(from_reserve=True)
+            self._own[slot, i] = True
+        self.version += 1
+        return row.copy()
+
+    def slot_page_count(self, slot):
+        """Mapped pages of ``slot`` (swap accounting)."""
+        return int(np.count_nonzero(self.tables[slot]))
+
     def ensure(self, slot, lo, hi):
         """Make positions [lo, hi) of ``slot`` writable.
 
@@ -166,6 +203,7 @@ class PagedKVManager:
             return copies
         row = self.tables[slot]
         m = self.pages_per_slot
+        v0 = self.version
         for ti in range(int(lo) // self.page_tokens,
                         (int(hi) - 1) // self.page_tokens + 1):
             idx = ti % m
@@ -174,6 +212,7 @@ class PagedKVManager:
             if page == 0:
                 row[idx] = self._alloc(slot)
                 self._own[slot, idx] = True
+                self.version = v0 + 1
                 continue
             if wrapped and self.prefix_cache is not None \
                     and self.allocator.shared(page):
@@ -192,6 +231,7 @@ class PagedKVManager:
             row[idx] = fresh
             self._own[slot, idx] = True
             self.allocator.forks += 1
+            self.version = v0 + 1
         if copies:
             from .. import obs as _obs
 
@@ -219,6 +259,8 @@ class PagedKVManager:
         """Retire ``slot`` NOW: drop its page refs (prefix-cache-held
         pages survive), zero its table row, release its reservation."""
         row = self.tables[slot]
+        if row.any():
+            self.version += 1
         for i in range(self.pages_per_slot):
             if row[i]:
                 self.allocator.decref(int(row[i]))

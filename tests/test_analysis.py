@@ -263,7 +263,8 @@ def test_decode_predictor_trace_counters():
         state, _ = pred.step(state, key)
     art = pred.decode_artifact(state)
     assert pred.trace_counts == {"prefill": 1, "decode": 1, "verify": 0,
-                                 "chunk": 0, "fork": 0, "commit": 0}
+                                 "chunk": 0, "fork": 0, "commit": 0,
+                                 "extract": 0, "install": 0}
     assert art.trace_count == 1 and art.donated_leaves == \
         len(jax.tree_util.tree_leaves(state))
     rep = run_passes([art, pred.prefill_artifact(2, 8)],

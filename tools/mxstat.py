@@ -19,10 +19,13 @@ Usage:
 * ``tools/mxstat.py --diff A.json B.json`` — headline / MFU / bytes
   deltas between two bench JSON contracts (``BENCH_r*.json``): the
   headline metric's value, the aggregate byte-ish extras
-  (``opt_update_bytes``, ``all_to_all_bytes``, ``dispatch_bytes``) and
-  a per-program join of the two ``mfu_table``s (bytes, flops, wall_s,
-  mfu), with absolute and percent deltas — the perf trajectory across
-  PRs as one readable table instead of two hand-diffed JSON blobs.
+  (``opt_update_bytes``, ``all_to_all_bytes``, ``dispatch_bytes``),
+  the fleet headline fields (``bench_fleet.py``: ``p95_ttft_ms``,
+  ``router_cache_hit_rate``, ``vs_round_robin``, migrated/swapped page
+  counts) and a per-program join of the two ``mfu_table``s (bytes,
+  flops, wall_s, mfu), with absolute and percent deltas — the perf
+  trajectory across PRs as one readable table instead of two
+  hand-diffed JSON blobs.
 * ``tools/mxstat.py --smoke``         — tier-1 CI mode
   (tests/test_bench_contract.py invokes it): drive the registry /
   timeline / roofline machinery end to end WITHOUT jax — concurrent
@@ -117,9 +120,15 @@ def _render_diff_table(rows):
     return "\n".join(lines)
 
 
+_EXTRA_SUFFIXES = (".ratio", ".count", "_ms", "_rate", "_pages",
+                   "_outs", "_prefills", "_tokens_per_sec",
+                   "vs_round_robin")
+
+
 def _flatten_bytes_extras(obj, prefix=""):
-    """The byte-ish scalar extras of a contract line, flattened:
-    opt_update_bytes.fused_bytes, dispatch_bytes.sort.bytes, ..."""
+    """The byte-ish / fleet-headline scalar extras of a contract line,
+    flattened: opt_update_bytes.fused_bytes, dispatch_bytes.sort.bytes,
+    p95_ttft_ms, router_cache_hit_rate, migrated_pages, ..."""
     out = {}
     for key, val in sorted((obj or {}).items()):
         if key in ("mfu_table",) or key.startswith("_"):
@@ -128,8 +137,8 @@ def _flatten_bytes_extras(obj, prefix=""):
         if isinstance(val, dict):
             out.update(_flatten_bytes_extras(val, name + "."))
         elif isinstance(val, (int, float)) and not isinstance(val, bool) \
-                and ("bytes" in name or name.endswith((".ratio",
-                                                       ".count"))):
+                and ("bytes" in name
+                     or name.endswith(_EXTRA_SUFFIXES)):
             out[name] = val
     return out
 
