@@ -63,6 +63,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SMOKE = "--smoke" in sys.argv
+COLD = "--cold-start" in sys.argv
 
 if SMOKE:
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -78,13 +79,16 @@ import numpy as np
 os.environ.setdefault("MXNET_FLEET_DECODE_BOUND", "3")
 
 
-def main():
-    import jax
+def emit(row):
+    print(json.dumps(row), file=sys.stderr, flush=True)
 
-    from mxnet_tpu import obs
-    from mxnet_tpu.decode import DecodePredictor, DecodeServer
+
+def model_setup():
+    """Dims, symbol, params and the predictor factory — shared by the
+    fleet drive and the ``--cold-start`` program-readiness phase (same
+    env knobs, same model, so the two headlines describe one fleet)."""
+    from mxnet_tpu.decode import DecodePredictor
     from mxnet_tpu.models import attention_lm
-    from mxnet_tpu.serve.fleet import FleetHost, PrefillWorker, Router
 
     n_hosts = int(os.environ.get("BENCH_FLEET_HOSTS",
                                  "2" if SMOKE else "3"))
@@ -109,11 +113,6 @@ def main():
     # cache covers prompt + generation + a page of slack
     cache_len = -(-(prefix_len + tail_hi + max_new + 1)
                   // page_tokens) * page_tokens + page_tokens
-    # the preemption drill's low-priority residents: long enough to stay
-    # decoding when the high-priority probe arrives, short enough not to
-    # leave a serial batch-of-one tail.  (Wrapped swap/restore
-    # bit-parity is pinned by tests/test_fleet.py.)
-    long_cap = 9 * max_new
     # pool: holds a host's steady working set — its share of tenant
     # prefixes plus the resident long request plus matched (tail-only)
     # admissions — but NOT a simultaneous cold full-prompt migration:
@@ -140,14 +139,39 @@ def main():
     for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
         params["aux:" + name] = np.zeros(shape, np.float32)
 
-    def emit(row):
-        print(json.dumps(row), file=sys.stderr, flush=True)
-
     def mk_pred(pool=pool_pages):
         return DecodePredictor(sym, params, cache_len=cache_len,
                                temperature=0.0, kv_dtype="",
                                paged=True, page_tokens=page_tokens,
                                pool_pages=pool, prefill_chunk=chunk)
+
+    return dict(n_hosts=n_hosts, tenants=tenants, per_tenant=per_tenant,
+                prefix_len=prefix_len, max_new=max_new,
+                page_tokens=page_tokens, chunk=chunk, vocab=vocab,
+                slots=slots, tail_lo=tail_lo, tail_hi=tail_hi,
+                cache_len=cache_len, pool_pages=pool_pages,
+                mk_pred=mk_pred)
+
+
+def main():
+    import jax
+
+    from mxnet_tpu import obs
+    from mxnet_tpu.decode import DecodeServer
+    from mxnet_tpu.serve.fleet import FleetHost, PrefillWorker, Router
+
+    cfg = model_setup()
+    n_hosts, tenants = cfg["n_hosts"], cfg["tenants"]
+    per_tenant, prefix_len = cfg["per_tenant"], cfg["prefix_len"]
+    max_new, page_tokens = cfg["max_new"], cfg["page_tokens"]
+    vocab, slots = cfg["vocab"], cfg["slots"]
+    tail_lo, tail_hi = cfg["tail_lo"], cfg["tail_hi"]
+    cache_len, mk_pred = cfg["cache_len"], cfg["mk_pred"]
+    # the preemption drill's low-priority residents: long enough to stay
+    # decoding when the high-priority probe arrives, short enough not to
+    # leave a serial batch-of-one tail.  (Wrapped swap/restore
+    # bit-parity is pinned by tests/test_fleet.py.)
+    long_cap = 9 * max_new
 
     # ---- the bursty multi-tenant shared-prefix trace -------------------
     trace_rng = np.random.RandomState(7)
@@ -347,5 +371,148 @@ def main():
     }))
 
 
+def cold_start_main():
+    """``--cold-start``: program-readiness wall clock per fleet host —
+    the warm AOT-cache path (deserialize every serving program,
+    ``mxnet_tpu.programs.aot``) vs the trace+lower+compile path every
+    host used to pay.  One build host populates the content-addressed
+    cache (the once-per-fleet cost, reported untimed); each of the
+    N hosts then cold-starts by loading.  Deterministic halves asserted
+    at every dims: all-hit/zero-miss warm loads, token identity of an
+    AOT-served drain vs the plain JIT reference, ZERO traces on the
+    AOT host's predictor, and fingerprint equality between a prefill
+    worker's programs and the decode hosts' (byte-identical programs,
+    provably).  Non-smoke acceptance: ``cold_start_vs_jit >= 3.0``.
+    """
+    import shutil
+    import tempfile
+
+    from mxnet_tpu import config as _config, obs
+    from mxnet_tpu.decode import DecodeServer
+    from mxnet_tpu.programs import aot as _aot
+
+    cfg = model_setup()
+    n_hosts, slots = cfg["n_hosts"], cfg["slots"]
+    vocab, cache_len = cfg["vocab"], cfg["cache_len"]
+    mk_pred, max_new = cfg["mk_pred"], cfg["max_new"]
+    spec_k = 3
+    # the server clamps its chunk width to the admission window; mirror
+    # it so prepared signatures match what serve_tick drives
+    chunk_w = min(cfg["chunk"] or cache_len, cache_len)
+
+    def mk_server(pred):
+        return DecodeServer(pred, max_prefill=cache_len, slots=slots,
+                            max_new_tokens=max_new, spec_k=spec_k)
+
+    trace_rng = np.random.RandomState(11)
+    prefix = trace_rng.randint(0, vocab, size=(cfg["page_tokens"] * 2,))
+    prompts = [np.concatenate([prefix, trace_rng.randint(
+        0, vocab, size=(n,))]) for n in (3, 7, 2, 5)]
+
+    with _config.overrides(MXNET_AOT="0"):
+        # reference tokens + the per-host JIT readiness baseline (every
+        # program traced+lowered+compiled, no cache anywhere)
+        ref_pred = mk_pred()
+        ref_srv = mk_server(ref_pred)
+        for p in prompts:
+            ref_srv.submit(p)
+        ref = ref_srv.run()
+        jit_wall = []
+        for _ in range(n_hosts):
+            pred = mk_pred()
+            tic = time.time()
+            pred.prepare_programs(slots, chunk_w=chunk_w, spec_k=spec_k,
+                                  mode="compile")
+            jit_wall.append(time.time() - tic)
+
+    cache = os.environ.get("BENCH_AOT_CACHE")
+    keep = bool(cache)
+    cache = cache or tempfile.mkdtemp(prefix="mxnet_aot_bench_")
+    try:
+        with _config.overrides(MXNET_AOT="1", MXNET_PROGRAM_CACHE=cache):
+            _aot.reset_stats()
+            # one build host populates the cache — once per fleet
+            pred0 = mk_pred()
+            srv0 = mk_server(pred0)
+            tic = time.time()
+            srv0.serve_open()
+            populate_s = time.time() - tic
+            populate = srv0.aot_report
+            programs_loaded = len(populate["programs"])
+            # warm cold start, per host: readiness is a deserialize
+            aot_wall, reports, hosts = [], [], []
+            for _ in range(n_hosts):
+                pred = mk_pred()
+                srv = mk_server(pred)
+                tic = time.time()
+                srv.serve_open()
+                aot_wall.append(time.time() - tic)
+                reports.append(srv.aot_report)
+                hosts.append((pred, srv))
+            hits = sum(r["hits"] for r in reports)
+            misses = sum(r["misses"] for r in reports)
+            assert misses == 0 and hits == programs_loaded * n_hosts, \
+                (hits, misses, programs_loaded)
+            # prefill workers provably run byte-identical programs to
+            # their target hosts: every fingerprint matches
+            wfp = mk_pred().program_fingerprints(slots, chunk_w=chunk_w,
+                                                 spec_k=spec_k)
+            hfp = hosts[0][0].program_fingerprints(slots, chunk_w=chunk_w,
+                                                   spec_k=spec_k)
+            worker_identical = wfp == hfp
+            assert worker_identical, (wfp, hfp)
+            # AOT-served drain: token-identical to the JIT reference,
+            # zero traces on the serving predictor, all-cache sources
+            pred1, srv1 = hosts[0]
+            for p in prompts:
+                srv1.submit(p)
+            out = srv1.run()
+            assert set(out) == set(ref)
+            token_identical = all(np.array_equal(ref[k], out[k])
+                                  for k in ref)
+            assert token_identical
+            zero_retraces = all(v == 0
+                                for v in pred1.trace_counts.values())
+            assert zero_retraces, pred1.trace_counts
+            sources = {k: v["source"]
+                       for k, v in srv1.aot_report["programs"].items()}
+            assert all(s == "cache" for s in sources.values()), sources
+    finally:
+        if not keep:
+            shutil.rmtree(cache, ignore_errors=True)
+
+    cold_start_s = sum(aot_wall) / n_hosts
+    jit_s = sum(jit_wall) / n_hosts
+    vs_jit = jit_s / max(cold_start_s, 1e-9)
+    emit({"phase": "cold_start", "hosts": n_hosts,
+          "programs": programs_loaded, "populate_s": round(populate_s, 3),
+          "jit_wall_s": [round(t, 3) for t in jit_wall],
+          "aot_wall_s": [round(t, 3) for t in aot_wall],
+          "sources": sources})
+    if not SMOKE:
+        # the acceptance line at full dims: a warm-cache host must be
+        # ready >= 3x faster than the trace+compile path
+        assert vs_jit >= 3.0, \
+            "AOT cold start is %.2fx JIT (acceptance: >= 3.0x)" % vs_jit
+    print(json.dumps({
+        "metric": "fleet_cold_start_s_h%d" % n_hosts,
+        "value": round(cold_start_s, 4),
+        "unit": "s",
+        "vs_baseline": round(vs_jit, 3),
+        "cold_start_s": round(cold_start_s, 4),
+        "cold_start_jit_s": round(jit_s, 4),
+        "cold_start_vs_jit": round(vs_jit, 3),
+        "populate_s": round(populate_s, 4),
+        "programs_loaded": programs_loaded,
+        "aot_hits": hits, "aot_misses": misses,
+        "aot_fallbacks": _aot.AOT_STATS["fallbacks"],
+        "worker_programs_identical": bool(worker_identical),
+        "token_identical": bool(token_identical),
+        "zero_retraces": bool(zero_retraces),
+        "hosts": n_hosts,
+        "mfu_table": obs.mfu_table(),
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    cold_start_main() if COLD else main()

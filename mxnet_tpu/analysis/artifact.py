@@ -55,6 +55,10 @@ class ProgramArtifact:
     # distinct traces this program legitimately needs (shape variants)
     trace_count: int = None
     expected_traces: int = 1
+    # content address of the compiled program when known
+    # (ProgramSpec.fingerprint — the AOT cache key; equal fingerprints
+    # prove two hosts run byte-identical programs)
+    fingerprint: str = None
     meta: dict = field(default_factory=dict)
 
     def describe(self):
@@ -68,12 +72,13 @@ class ProgramArtifact:
             "mesh_shape": self.mesh_shape,
             "trace_count": self.trace_count,
             "expected_traces": self.expected_traces,
+            "fingerprint": self.fingerprint,
         }
 
 
 def artifact_from_jit(fn, args, name, donated_leaves=0, compute_dtype=None,
                       mesh_shape=None, trace_count=None, expected_traces=1,
-                      compile_program=True, **meta):
+                      compile_program=True, fingerprint=None, **meta):
     """Build a :class:`ProgramArtifact` from a ``jax.jit``-wrapped callable
     and the (abstract or concrete) arguments that select its trace.
 
@@ -92,4 +97,5 @@ def artifact_from_jit(fn, args, name, donated_leaves=0, compute_dtype=None,
         name=name, jaxpr_text=jaxpr_text, stablehlo_text=stablehlo_text,
         compiled_text=compiled_text, donated_leaves=donated_leaves,
         compute_dtype=compute_dtype, mesh_shape=mesh_shape,
-        trace_count=trace_count, expected_traces=expected_traces, meta=meta)
+        trace_count=trace_count, expected_traces=expected_traces,
+        fingerprint=fingerprint, meta=meta)

@@ -183,6 +183,17 @@ class RetracePass(Pass):
                 artifact, "info", "no retrace instrumentation on this "
                 "artifact", code="no-instrumentation")]
         record = artifact.meta.get("retrace") or {}
+        if artifact.meta.get("aot") and artifact.trace_count == 0:
+            # AOT-prepared programs dispatch a deserialized (or
+            # probe-compiled) executable: zero python-level traces is
+            # the DESIGNED state, not missing instrumentation — surface
+            # the provenance so "every host runs the canonical program,
+            # not a local retrace" reads straight off the lint
+            return [self.finding(
+                artifact, "info",
+                "0 traces: program dispatches an AOT %s executable "
+                "(mxnet_tpu.programs.aot)" % artifact.meta["aot"],
+                code="aot-loaded", source=artifact.meta["aot"])]
         if artifact.trace_count <= artifact.expected_traces:
             return [self.finding(
                 artifact, "info",

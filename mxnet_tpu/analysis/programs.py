@@ -67,13 +67,9 @@ from __future__ import annotations
 import numpy as np
 
 from ..base import MXNetError
+from ..programs import registry as _registry
 
 __all__ = ["CANONICAL_PROGRAMS", "build_canonical_artifacts"]
-
-CANONICAL_PROGRAMS = ("train_step", "eval_step", "prefill", "decode_step",
-                      "decode_step_q", "draft_step", "verify_step",
-                      "paged_decode_step", "paged_verify_step",
-                      "ring_tp_step", "moe_train_step", "ckpt_train_step")
 
 # tiny-but-structured dims shared by every builder
 _MLP = dict(batch=8, features=32, hidden=32, classes=8)
@@ -434,98 +430,126 @@ def _moe_train_step_artifact():
     return step.artifact(name="moe_train_step")
 
 
+# ---------------------------------------------------------------------------
+# registry registrations — this module IS the canonical catalog now:
+# each builder group registers once with mxnet_tpu.programs.registry,
+# mxlint enumerates registry.canonical_names(), and adding the 13th
+# canonical program is one register_canonical call
+# ---------------------------------------------------------------------------
+def _train_eval_builder(want):
+    # the canonical train_step is audited WITH the fused multi-tensor
+    # Pallas optimizer update armed (interpret off-TPU), so the
+    # flop-dtype pass's pallas-fallback tripwire proves the kernel
+    # lowered — the same arming story as the paged decode programs
+    from .. import config as _config
+
+    import jax as _jax
+
+    knobs = {"MXNET_PALLAS_UPDATE": "1"}
+    if _jax.default_backend() != "tpu":
+        knobs["MXNET_PALLAS_INTERPRET"] = "1"
+    out = []
+    with _config.overrides(**knobs):
+        mod, batch = _mlp_module()
+        if "train_step" in want:
+            # the eval program needs only the bound group; driving (and
+            # compiling) the fused step is the train artifact's cost
+            step = _drive_fused(mod, batch)
+            if step._plan is None:
+                raise MXNetError(
+                    "MXNET_PALLAS_UPDATE armed but the canonical "
+                    "MLP step built no update plan (SGD-momentum "
+                    "f32 masters must be in scope)")
+            out.append(("train_step", step.artifact(name="train_step")))
+        if "eval_step" in want:
+            out.append(("eval_step", _eval_artifact(mod, batch)))
+    return out
+
+
+def _decode_builder(want):
+    prefill, decode = _decode_artifacts()
+    return [("prefill", prefill), ("decode_step", decode)]
+
+
+def _speculative_builder(want):
+    decode_q, draft, verify = _speculative_artifacts()
+    return [("decode_step_q", decode_q), ("draft_step", draft),
+            ("verify_step", verify)]
+
+
+def _paged_builder(want):
+    paged_decode, paged_verify = _paged_artifacts()
+    return [("paged_decode_step", paged_decode),
+            ("paged_verify_step", paged_verify)]
+
+
+def _mesh_note(kind):
+    import jax
+
+    return ("needs >= 4 devices for a %s mesh; %d present — run under "
+            "the 8-virtual-device CPU platform (tools/mxlint.py --smoke "
+            "does this)" % (kind, len(jax.devices())))
+
+
+def _ring_available():
+    import jax
+
+    return None if _ring_mesh_config(len(jax.devices())) is not None \
+        else _mesh_note("(seq, model)")
+
+
+def _ring_builder(want):
+    import jax
+
+    mod, batch = _lm_mesh_module(_ring_mesh_config(len(jax.devices())))
+    step = _drive_fused(mod, batch)
+    return [("ring_tp_step", step.artifact(name="ring_tp_step"))]
+
+
+def _moe_available():
+    import jax
+
+    return None if _moe_mesh_config(len(jax.devices())) is not None \
+        else _mesh_note("(expert, model)")
+
+
+def _moe_builder(want):
+    return [("moe_train_step", _moe_train_step_artifact())]
+
+
+def _ckpt_builder(want):
+    return [("ckpt_train_step", _ckpt_train_step_artifact())]
+
+
+if "train_step" not in _registry.canonical_names():
+    # registered once per process (module reloads must not re-register)
+    _registry.register_canonical(("train_step", "eval_step"),
+                                 _train_eval_builder)
+    _registry.register_canonical(("prefill", "decode_step"),
+                                 _decode_builder)
+    _registry.register_canonical(
+        ("decode_step_q", "draft_step", "verify_step"),
+        _speculative_builder)
+    _registry.register_canonical(
+        ("paged_decode_step", "paged_verify_step"), _paged_builder)
+    _registry.register_canonical(("ring_tp_step",), _ring_builder,
+                                 availability=_ring_available)
+    _registry.register_canonical(("moe_train_step",), _moe_builder,
+                                 availability=_moe_available)
+    _registry.register_canonical(("ckpt_train_step",), _ckpt_builder)
+
+# the catalog, enumerated from the registry (kept as a module constant
+# for existing importers)
+CANONICAL_PROGRAMS = _registry.canonical_names()
+
+
 def build_canonical_artifacts(names=None):
-    """Build the requested canonical artifacts (default: all twelve).
+    """Build the requested canonical artifacts (default: all twelve) —
+    a registry enumeration now (``programs.registry.build_canonical``).
 
     Returns ``(artifacts, notes)`` — ``notes`` maps a program that could
     not be built on this host (e.g. ``ring_tp_step`` without >= 4
     devices) to the reason, so the caller can surface the gap instead of
     silently auditing a smaller set.
     """
-    import jax
-
-    want = list(names) if names else list(CANONICAL_PROGRAMS)
-    unknown = [n for n in want if n not in CANONICAL_PROGRAMS]
-    if unknown:
-        raise MXNetError("unknown canonical program(s) %s; known: %s"
-                         % (unknown, list(CANONICAL_PROGRAMS)))
-    artifacts, notes = [], {}
-
-    if "train_step" in want or "eval_step" in want:
-        # the canonical train_step is audited WITH the fused multi-tensor
-        # Pallas optimizer update armed (interpret off-TPU), so the
-        # flop-dtype pass's pallas-fallback tripwire proves the kernel
-        # lowered — the same arming story as the paged decode programs
-        from .. import config as _config
-
-        import jax as _jax
-
-        knobs = {"MXNET_PALLAS_UPDATE": "1"}
-        if _jax.default_backend() != "tpu":
-            knobs["MXNET_PALLAS_INTERPRET"] = "1"
-        with _config.overrides(**knobs):
-            mod, batch = _mlp_module()
-            if "train_step" in want:
-                # the eval program needs only the bound group; driving
-                # (and compiling) the fused step is the train artifact's
-                # cost
-                step = _drive_fused(mod, batch)
-                if step._plan is None:
-                    raise MXNetError(
-                        "MXNET_PALLAS_UPDATE armed but the canonical "
-                        "MLP step built no update plan (SGD-momentum "
-                        "f32 masters must be in scope)")
-                artifacts.append(step.artifact(name="train_step"))
-            if "eval_step" in want:
-                artifacts.append(_eval_artifact(mod, batch))
-
-    if "prefill" in want or "decode_step" in want:
-        prefill, decode = _decode_artifacts()
-        if "prefill" in want:
-            artifacts.append(prefill)
-        if "decode_step" in want:
-            artifacts.append(decode)
-
-    if {"decode_step_q", "draft_step", "verify_step"} & set(want):
-        decode_q, draft, verify = _speculative_artifacts()
-        if "decode_step_q" in want:
-            artifacts.append(decode_q)
-        if "draft_step" in want:
-            artifacts.append(draft)
-        if "verify_step" in want:
-            artifacts.append(verify)
-
-    if {"paged_decode_step", "paged_verify_step"} & set(want):
-        paged_decode, paged_verify = _paged_artifacts()
-        if "paged_decode_step" in want:
-            artifacts.append(paged_decode)
-        if "paged_verify_step" in want:
-            artifacts.append(paged_verify)
-
-    if "ckpt_train_step" in want:
-        artifacts.append(_ckpt_train_step_artifact())
-
-    if "moe_train_step" in want:
-        if _moe_mesh_config(len(jax.devices())) is None:
-            notes["moe_train_step"] = (
-                "needs >= 4 devices for an (expert, model) mesh; %d "
-                "present — run under the 8-virtual-device CPU platform "
-                "(tools/mxlint.py --smoke does this)" % len(jax.devices()))
-        else:
-            artifacts.append(_moe_train_step_artifact())
-
-    if "ring_tp_step" in want:
-        cfg = _ring_mesh_config(len(jax.devices()))
-        if cfg is None:
-            notes["ring_tp_step"] = (
-                "needs >= 4 devices for a (seq, model) mesh; %d present "
-                "— run under the 8-virtual-device CPU platform "
-                "(tools/mxlint.py --smoke does this)" % len(jax.devices()))
-        else:
-            mod, batch = _lm_mesh_module(cfg)
-            step = _drive_fused(mod, batch)
-            artifacts.append(step.artifact(name="ring_tp_step"))
-
-    order = {n: i for i, n in enumerate(CANONICAL_PROGRAMS)}
-    artifacts.sort(key=lambda a: order.get(a.name, len(order)))
-    return artifacts, notes
+    return _registry.build_canonical(names)

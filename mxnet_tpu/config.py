@@ -477,6 +477,31 @@ register("MXNET_FLEET_PREFILL_THRESHOLD", float, 0.5,
          "committed pages migrate to the least-loaded decode host "
          "(DistServe-style prefill/decode split).  Only consulted when "
          "the router has prefill workers.")
+register("MXNET_AOT", bool, False,
+         "Arm the AOT-serialized program pipeline (mxnet_tpu.programs."
+         "aot): DecodeServer.serve_open prepares every paged serving "
+         "program (chunk prefill, decode, verify, commit, fork, page "
+         "extract/install) through the content-addressed program cache "
+         "— a cache hit DESERIALIZES the compiled executable "
+         "(milliseconds) instead of trace+lower+compile (seconds to "
+         "minutes per host), and a miss compiles once and saves the "
+         "executable back for the next host's cold start.  Loaded "
+         "programs are byte-identical to the JIT path (same lowering) "
+         "and dispatch with ZERO traces; an argument signature the "
+         "executable was not compiled for falls back to JIT with a "
+         "visible warning.  Covers paged single-host predictors; "
+         "mesh-sharded and dense predictors keep the JIT path (logged "
+         "at serve_open — serialized executables pin device layouts).  "
+         "0 (default) = classic JIT-on-first-call.")
+register("MXNET_PROGRAM_CACHE", str, "",
+         "Directory of the content-addressed AOT program cache "
+         "(mxnet_tpu.programs.aot): <fingerprint>.aotx serialized "
+         "executables plus .json sidecars, keyed over (abstract args, "
+         "donation map, partition rules, jax version, backend, mesh "
+         "shape, model graph digest) — any drift is a key miss, never "
+         "a wrong program.  Empty (default) = ~/.cache/mxnet_tpu/"
+         "programs.  Shared read-only across fleet hosts; equal keys "
+         "prove byte-identical programs (docs/programs.md).")
 register("MXNET_HEARTBEAT_DIR", str, "",
          "Shared directory for worker liveness heartbeats (failure "
          "detection, parallel/health.py; reference ps-lite heartbeats). "

@@ -245,27 +245,29 @@ class DecodePredictor:
                              "nothing to cache — use Predictor")
 
         self._cache_sharding = None
+        self._partition_rules = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from .parallel.tp_rules import (kv_cache_pspec,
                                             plan_tensor_parallel)
+            from .programs.partition import build_shardings, \
+                rules_from_plan
 
             sizes = dict(mesh.shape)
             model_par = sizes.get("model", 1)
             rep = NamedSharding(mesh, P())
+            # the Megatron graph-walk plan, funneled through the ONE
+            # regex partition-rule matcher (programs.partition) — the
+            # same degrade-to-replicated guard, now shared with every
+            # registered program's pspec plumbing
             plan = plan_tensor_parallel(symbol) if model_par > 1 else {}
-
-            def place(name, arr):
-                spec = plan.get(name)
-                if spec is not None and len(spec) == len(arr.shape) and all(
-                        ax is None or arr.shape[d] % sizes.get(ax, 1) == 0
-                        for d, ax in enumerate(spec)):
-                    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
-                return jax.device_put(arr, rep)
-
-            self._env = {n: place(n, a.data)
-                         for n, a in arg_params.items()}
+            self._partition_rules = rules_from_plan(plan)
+            arg_data = {n: a.data for n, a in arg_params.items()}
+            shardings = build_shardings(mesh, self._partition_rules,
+                                        arg_data)
+            self._env = {n: jax.device_put(v, shardings[n])
+                         for n, v in arg_data.items()}
             self._env.update({n: jax.device_put(a.data, rep)
                               for n, a in aux_params.items()})
             self._cache_sharding = NamedSharding(
@@ -295,32 +297,48 @@ class DecodePredictor:
                              "extract": 0, "install": 0}
         self._probing = False
         if self._paged:
+            from .programs.aot import AotDispatch
+
             # paged programs take (page tables, active mask) as DATA; the
-            # chunk program is the whole prefill story (one fixed width)
-            self._decode_fn = jax.jit(self._paged_decode_impl,
-                                      donate_argnums=donate)
-            self._verify_fn = jax.jit(self._paged_verify_impl,
-                                      donate_argnums=donate)
+            # chunk program is the whole prefill story (one fixed width).
+            # Each is an AotDispatch facade: a plain jax.jit pass-through
+            # until prepare_programs() arms an AOT-deserialized (or
+            # freshly compiled) executable — the fleet cold-start path
             half = (1,) if self._donate else ()
-            self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=half)
-            self._fork_fn = jax.jit(
-                self._fork_impl,
-                donate_argnums=(0,) if self._donate else ())
-            self._commit_fn = jax.jit(
-                self._commit_impl,
-                donate_argnums=(0, 1) if self._donate else ())
+            self._decode_fn = AotDispatch(
+                "paged_decode_step", jax.jit(self._paged_decode_impl,
+                                             donate_argnums=donate))
+            self._verify_fn = AotDispatch(
+                "paged_verify_step", jax.jit(self._paged_verify_impl,
+                                             donate_argnums=donate))
+            self._chunk_fn = AotDispatch(
+                "prefill_chunk", jax.jit(self._chunk_impl,
+                                         donate_argnums=half))
+            self._fork_fn = AotDispatch(
+                "page_fork", jax.jit(
+                    self._fork_impl,
+                    donate_argnums=(0,) if self._donate else ()))
+            self._commit_fn = AotDispatch(
+                "slot_commit", jax.jit(
+                    self._commit_impl,
+                    donate_argnums=(0, 1) if self._donate else ()))
             # page migration/swap: gather a slot's table row out of the
             # pools / scatter saved page contents back in.  Row ids are
             # DATA — one trace each serves every migration, swap-out and
             # readmit (serve.fleet / serve.swap)
-            self._extract_fn = jax.jit(self._extract_impl)
-            self._install_fn = jax.jit(
-                self._install_impl,
-                donate_argnums=(0,) if self._donate else ())
+            self._extract_fn = AotDispatch(
+                "page_extract", jax.jit(self._extract_impl))
+            self._install_fn = AotDispatch(
+                "page_install", jax.jit(
+                    self._install_impl,
+                    donate_argnums=(0,) if self._donate else ()))
             self._manager = None          # serve.PagedKVManager, per batch
             self._pools_template = None   # per-node cache avals (probed)
             self._paged_lens = None       # host mirror for standalone use
             self._chunk_widths = set()    # distinct chunk widths driven
+            self._aot_report = None       # last prepare_programs() result
+            self._program_specs = {}      # kind -> ProgramSpec (owned
+            # here; the global registry only holds weakrefs to these)
         else:
             self._decode_fn = jax.jit(self._decode_impl,
                                       donate_argnums=donate)
@@ -366,15 +384,16 @@ class DecodePredictor:
 
     def _roofline_static(self, name):
         """Price one snapped program (trace+lower only; probe-flagged so
-        the trace counters stay honest)."""
-        from .analysis.cost import program_cost
+        the trace counters stay honest).  A program dispatching an
+        AOT-loaded executable carries its source in the row."""
+        from .programs.spec import probe_cost
 
         fn, args = self._static_args[name]
-        self._probing = True
-        try:
-            return program_cost(fn, args)
-        finally:
-            self._probing = False
+        cost = probe_cost(self, fn, args)
+        src = getattr(fn, "source", None)
+        if cost is not None and src and src != "jit":
+            cost = dict(cost, aot=src)
+        return cost
 
     # ------------------------------------------------------------------
     # the shared graph walk (traced inside both programs)
@@ -777,15 +796,14 @@ class DecodePredictor:
         import jax
         import jax.numpy as jnp
 
+        from .programs.spec import probing
+
         env = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for n, v in self._env.items()}
         toks = jax.ShapeDtypeStruct((1, 1), jnp.float32)
-        self._probing = True
-        try:
+        with probing(self):
             return jax.eval_shape(
                 lambda e, t: self._run(e, t, None, 0)[1], env, toks)
-        finally:
-            self._probing = False
 
     def _place_pool(self, buf, is_scale=False):
         """Mesh placement for a (P, page_tokens, E|H) pool: heads shard
@@ -866,6 +884,206 @@ class DecodePredictor:
         return sum(shape_bytes(shape_str((pp, pt, aval.shape[2]),
                                          aval.dtype))
                    for aval in jtu.tree_leaves(self._pools_template))
+
+    # ------------------------------------------------------------------
+    # AOT-serialized program preparation — the fleet cold-start path
+    # (mxnet_tpu.programs.aot, docs/programs.md)
+    # ------------------------------------------------------------------
+    # donation maps of the paged serving programs, by kind (must mirror
+    # the jit donate_argnums above; _donate off zeroes them all)
+    _AOT_DONATE = {"decode": (1,), "verify": (1,), "chunk": (1,),
+                   "commit": (0, 1), "fork": (0,), "extract": (),
+                   "install": (0,)}
+
+    def _aot_dispatches(self):
+        """kind -> the :class:`~mxnet_tpu.programs.aot.AotDispatch`
+        facade serving it (paged mode only)."""
+        return {"chunk": self._chunk_fn, "decode": self._decode_fn,
+                "verify": self._verify_fn, "commit": self._commit_fn,
+                "fork": self._fork_fn, "extract": self._extract_fn,
+                "install": self._install_fn}
+
+    def _symbol_fingerprint(self):
+        """Digest of the model graph — the program-identity component
+        of the AOT cache key (two predictors with equal avals but
+        different symbols must never share an executable).
+
+        Auto-generated OP node names are canonicalized to their topo
+        index before hashing: gensym counters depend on how many
+        symbols a process built earlier, and two hosts constructing
+        the same model after different warmup must still produce the
+        SAME key (graph edges are index-based in the json, so op-node
+        labels are decorative; variable names stay — they key the
+        param env and are already part of the aval treedef)."""
+        import hashlib
+        import json as _json
+
+        d = getattr(self, "_sym_digest", None)
+        if d is None:
+            g = _json.loads(self._symbol.tojson())
+            for i, node in enumerate(g.get("nodes", ())):
+                if node.get("op") not in (None, "null"):
+                    node["name"] = "n%d" % i
+            blob = _json.dumps(g, sort_keys=True)
+            d = hashlib.blake2b(blob.encode(),
+                                digest_size=16).hexdigest()
+            self._sym_digest = d
+        return d
+
+    def serving_avals(self, slots, chunk_w=None, spec_k=0):
+        """Abstract args of every paged serving program at batch width
+        ``slots`` — the exact signatures the serving loop drives, built
+        WITHOUT tracing, compiling or allocating pools (the cache-shape
+        probe is ``jax.eval_shape`` only).  This is what lets a fleet
+        host fingerprint and AOT-load its programs before it has served
+        a single token."""
+        import jax
+        import jax.numpy as jnp
+
+        from .analysis.artifact import aval_of
+        from .ops.attention import QuantKV
+        from .serve.manager import PagedKVManager
+
+        if not self._paged:
+            raise MXNetError("serving_avals needs a paged predictor")
+        if self._pools_template is None:
+            self._pools_template = self._probe_cache_shapes()
+        slots = int(slots)
+        pt = self._page_tokens
+        m = self._cache_len // pt
+        pp = PagedKVManager.pool_sizing(slots, self._cache_len, pt,
+                                        self._pool_pages)
+        sds = jax.ShapeDtypeStruct
+
+        def build(shape_of):
+            pools = []
+            for kc, vc in self._pools_template:
+                pair = []
+                for aval in (kc, vc):
+                    if isinstance(aval, QuantKV):
+                        pair.append(QuantKV(
+                            sds(shape_of(aval.data), aval.data.dtype),
+                            sds(shape_of(aval.scale), aval.scale.dtype)))
+                    else:
+                        pair.append(sds(shape_of(aval), aval.dtype))
+                pools.append(tuple(pair))
+            return tuple(pools)
+
+        caches = build(lambda a: (pp, pt, a.shape[2]))
+        # one slot's extracted pages: the pool gathered at an (M,) row
+        data = build(lambda a: (m, pt, a.shape[2]))
+        env = {n: aval_of(v) for n, v in self._env.items()}
+        lens = sds((slots,), jnp.int32)
+        tok = sds((slots, 1), jnp.int32)
+        state = DecodeState(caches, lens, tok)
+        tables = sds((slots, m), jnp.int32)
+        active = sds((slots,), jnp.int32)
+        key = aval_of(self._zero_key)
+        i32 = sds((), jnp.int32)
+        row = sds((m,), jnp.int32)
+        cw = int(chunk_w or self._prefill_chunk or self._cache_len)
+        out = {
+            "chunk": (env, caches, sds((1, m), jnp.int32),
+                      sds((1, cw), jnp.float32), sds((1,), jnp.int32),
+                      sds((1,), jnp.int32), key),
+            "decode": (env, state, tables, active, key),
+            "commit": (lens, tok, i32, sds((1,), jnp.int32),
+                       sds((1, 1), jnp.int32)),
+            "fork": (caches, i32, i32),
+            "extract": (caches, row),
+            "install": (caches, row, data),
+        }
+        if spec_k:
+            out["verify"] = (env, state, tables, active,
+                             sds((slots, int(spec_k)), jnp.int32), None,
+                             key)
+        return out
+
+    def prepare_programs(self, slots, chunk_w=None, spec_k=0,
+                         mode="aot", save_ok=True):
+        """Make every paged serving program READY at batch width
+        ``slots`` before the first request: load the AOT-serialized
+        executable from the content-addressed program cache (a
+        deserialize — milliseconds), or trace + lower + compile now on
+        a miss (saved back when ``save_ok``, so the next host's cold
+        start is a deserialize).  Loaded executables are armed on the
+        dispatch facades: serving then runs them with ZERO traces and
+        byte-identical results to the JIT path.
+
+        ``mode="compile"`` bypasses the cache entirely (pure
+        trace+lower+compile, nothing saved) — the cold-start bench's
+        JIT baseline.  Returns the readiness report: per-program
+        {source, key, seconds} plus hit/miss counts and total wall;
+        idempotent per (slots, chunk width, spec_k) in ``"aot"`` mode.
+        """
+        import time as _time
+
+        from .programs import aot as _aot, registry as _registry
+
+        sig = (int(slots), int(chunk_w or 0), int(spec_k or 0))
+        rep = self._aot_report
+        if mode == "aot" and rep is not None \
+                and rep.get("signature") == sig:
+            return rep
+        avals = self.serving_avals(slots, chunk_w=chunk_w, spec_k=spec_k)
+        report = {"signature": sig, "programs": {}, "hits": 0,
+                  "misses": 0, "wall_s": 0.0}
+        t_all = _time.perf_counter()
+        for kind, args in avals.items():
+            spec = self._aot_spec(kind, args)
+            disp = self._aot_dispatches()[kind]
+            self._program_specs[kind] = _registry.register(spec)
+            t0 = _time.perf_counter()
+            if mode == "compile":
+                key = spec.fingerprint(args)
+                exe, source = spec.compiled(args), "compile"
+            else:
+                exe, source, key = _aot.load_or_compile(
+                    spec, args, save_ok=save_ok)
+            dt = _time.perf_counter() - t0
+            if exe is not None:
+                disp.arm(exe, source, key)
+            report["programs"][kind] = {
+                "name": disp.name, "source": source, "key": key,
+                "seconds": round(dt, 6)}
+            if source == "cache":
+                report["hits"] += 1
+            elif mode != "compile":
+                report["misses"] += 1
+        report["wall_s"] = round(_time.perf_counter() - t_all, 6)
+        if mode == "aot":
+            self._aot_report = report
+        return report
+
+    def _aot_spec(self, kind, args):
+        """The :class:`~mxnet_tpu.programs.spec.ProgramSpec` of one
+        paged serving program at concrete abstract args — donation map,
+        partition rules, trace counter and the program-identity
+        fingerprint extras all registered in one place."""
+        from .programs.spec import ProgramSpec
+
+        disp = self._aot_dispatches()[kind]
+        extra = {"symbol": self._symbol_fingerprint(),
+                 "cache_len": self._cache_len,
+                 "page_tokens": self._page_tokens,
+                 "kv_dtype": str(self._kv_dtype),
+                 "temperature": self._temperature, "top_k": self._top_k,
+                 "donate": self._donate, "kind": kind}
+        return ProgramSpec(
+            disp.name, disp, owner=self,
+            donate_argnums=self._AOT_DONATE[kind] if self._donate else (),
+            abstract_args=lambda a=args: a,
+            trace_count=lambda c=kind: self.trace_counts.get(c),
+            partition_rules=self._partition_rules,
+            fingerprint_extra=extra)
+
+    def program_fingerprints(self, slots, chunk_w=None, spec_k=0):
+        """kind -> content-address of each paged serving program at this
+        sizing — equal keys across hosts/workers PROVE byte-identical
+        programs (the serve-what-was-audited invariant)."""
+        avals = self.serving_avals(slots, chunk_w=chunk_w, spec_k=spec_k)
+        return {kind: self._aot_spec(kind, args).fingerprint(args)
+                for kind, args in avals.items()}
 
     def _run_forks(self, caches, copies):
         """Execute a manager-planned list of (src, dst) page copies —
@@ -1260,17 +1478,16 @@ class DecodePredictor:
         """Lowered (pre-optimization) StableHLO of the decode-step program
         at this state's shapes — feed to ``parallel.hlo_stats.dot_flops``
         for the O(1)-in-prefix FLOP assertion (bench_decode.py)."""
+        from .programs.spec import probe_lowered_text
+
         key = key if key is not None else self._zero_key
-        self._probing = True
-        try:
-            if self._paged:
-                tables, active = self._paged_probe_args(state)
-                return self._decode_fn.lower(
-                    self._env, state, tables, active, key).as_text()
-            return self._decode_fn.lower(
-                self._env, state, key).as_text()
-        finally:
-            self._probing = False
+        if self._paged:
+            tables, active = self._paged_probe_args(state)
+            return probe_lowered_text(
+                self, self._decode_fn,
+                (self._env, state, tables, active, key))
+        return probe_lowered_text(self, self._decode_fn,
+                                  (self._env, state, key))
 
     def _prefill_args(self, b, p):
         import jax
@@ -1293,12 +1510,10 @@ class DecodePredictor:
             raise MXNetError("paged mode prefills through the chunk "
                              "program; there is no one-shot prefill "
                              "program to probe")
+        from .programs.spec import probe_lowered_text
+
         fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
-        self._probing = True
-        try:
-            return fn.lower(*self._prefill_args(b, p)).as_text()
-        finally:
-            self._probing = False
+        return probe_lowered_text(self, fn, self._prefill_args(b, p))
 
     def prefill_artifact(self, b, p, name="prefill"):
         """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
@@ -1307,25 +1522,20 @@ class DecodePredictor:
         admitted (B, P) shape."""
         import jax
 
-        from .analysis.artifact import artifact_from_jit
+        from .programs.spec import probe_artifact
 
         if self._paged:
             raise MXNetError("paged mode prefills through the chunk "
                              "program; there is no one-shot prefill "
                              "program to snapshot")
         fn = self._prefill_fns.get((b, p)) or jax.jit(self._prefill_impl)
-        count = self.trace_counts["prefill"]
-        expected = max(len(self._prefill_fns), 1)
-        self._probing = True
-        try:
-            return artifact_from_jit(
-                fn, self._prefill_args(b, p), name=name, donated_leaves=0,
-                mesh_shape=dict(self._mesh.shape)
-                if self._mesh is not None else None,
-                trace_count=count, expected_traces=expected,
-                cache_len=self._cache_len)
-        finally:
-            self._probing = False
+        return probe_artifact(
+            self, fn, self._prefill_args(b, p), name, donated_leaves=0,
+            mesh_shape=dict(self._mesh.shape)
+            if self._mesh is not None else None,
+            trace_count=self.trace_counts["prefill"],
+            expected_traces=max(len(self._prefill_fns), 1),
+            cache_len=self._cache_len)
 
     def cache_bytes(self, state):
         """Static byte size of the ring caches behind ``state`` — data
@@ -1340,12 +1550,14 @@ class DecodePredictor:
         return sum(shape_bytes(shape_str(leaf.shape, leaf.dtype))
                    for leaf in jtu.tree_leaves(state.caches))
 
-    def _cache_meta(self, state):
+    def _cache_meta(self, state, fn=None):
         """Cache metadata for artifacts: the static byte budget plus the
         DATA dtypes actually stored (the cache-bytes pass flags an f32
         data plane inside a quantized config from these) and the cache
         layout (the pass flags a dense-ring allocation under a paged
-        config — the memory-manager plumbing was dropped)."""
+        config — the memory-manager plumbing was dropped).  ``fn`` is
+        the dispatch whose AOT provenance the artifact describes
+        (default: the decode step's)."""
         from . import config as _config
         from .ops.attention import QuantKV
 
@@ -1379,6 +1591,16 @@ class DecodePredictor:
             meta["page_tokens"] = self._page_tokens
             if self._manager is not None:
                 meta["pool_pages"] = self._manager.pool_pages
+            # AOT provenance: a dispatch armed with a cached
+            # (deserialized) or freshly compiled executable serves with
+            # zero traces BY CONSTRUCTION — the retrace pass reads this
+            # instead of flagging the 0-count as uninstrumented.  Per
+            # program: the verify artifact must not inherit the decode
+            # step's source when only decode was prepared
+            src = getattr(fn if fn is not None else self._decode_fn,
+                          "source", "jit")
+            if src != "jit":
+                meta["aot"] = src
         return meta
 
     def _refine_pallas_meta(self, art):
@@ -1401,29 +1623,25 @@ class DecodePredictor:
         byte + dtype meta for the cache-bytes pass)."""
         import jax.tree_util as jtu
 
-        from .analysis.artifact import artifact_from_jit, aval_of as _aval
+        from .analysis.artifact import aval_of as _aval
+        from .programs.spec import probe_artifact
 
         env = {n: _aval(v) for n, v in self._env.items()}
         astate = jtu.tree_map(_aval, state)
         akey = _aval(key if key is not None else self._zero_key)
         donated = len(jtu.tree_leaves(astate)) if self._donate else 0
-        count = self.trace_counts["decode"]
-        self._probing = True
-        try:
-            if self._paged:
-                tables, active = self._paged_probe_args(state)
-                args = (env, astate, _aval(tables), _aval(active), akey)
-            else:
-                args = (env, astate, akey)
-            return self._refine_pallas_meta(artifact_from_jit(
-                self._decode_fn, args, name=name,
-                donated_leaves=donated,
-                mesh_shape=dict(self._mesh.shape)
-                if self._mesh is not None else None,
-                trace_count=count, expected_traces=1,
-                cache_len=self._cache_len, **self._cache_meta(state)))
-        finally:
-            self._probing = False
+        if self._paged:
+            tables, active = self._paged_probe_args(state)
+            args = (env, astate, _aval(tables), _aval(active), akey)
+        else:
+            args = (env, astate, akey)
+        return probe_artifact(
+            self, self._decode_fn, args, name,
+            refine=self._refine_pallas_meta, donated_leaves=donated,
+            mesh_shape=dict(self._mesh.shape)
+            if self._mesh is not None else None,
+            trace_count=self.trace_counts["decode"], expected_traces=1,
+            cache_len=self._cache_len, **self._cache_meta(state))
 
     def verify_artifact(self, state, k, draft_probs=None, key=None,
                         name="verify_step"):
@@ -1437,7 +1655,8 @@ class DecodePredictor:
         import jax.numpy as jnp
         import jax.tree_util as jtu
 
-        from .analysis.artifact import artifact_from_jit, aval_of as _aval
+        from .analysis.artifact import aval_of as _aval
+        from .programs.spec import probe_artifact
 
         import jax
 
@@ -1448,26 +1667,21 @@ class DecodePredictor:
         aq = _aval(draft_probs) if draft_probs is not None else None
         akey = _aval(key if key is not None else self._zero_key)
         donated = len(jtu.tree_leaves(astate)) if self._donate else 0
-        count = self.trace_counts["verify"]
-        expected = max(len(self._verify_shapes), 1)
-        self._probing = True
-        try:
-            if self._paged:
-                tables, active = self._paged_probe_args(state)
-                args = (env, astate, _aval(tables), _aval(active), atoks,
-                        aq, akey)
-            else:
-                args = (env, astate, atoks, aq, akey)
-            return self._refine_pallas_meta(artifact_from_jit(
-                self._verify_fn, args, name=name,
-                donated_leaves=donated,
-                mesh_shape=dict(self._mesh.shape)
-                if self._mesh is not None else None,
-                trace_count=count, expected_traces=expected,
-                cache_len=self._cache_len, spec_k=int(k),
-                **self._cache_meta(state)))
-        finally:
-            self._probing = False
+        if self._paged:
+            tables, active = self._paged_probe_args(state)
+            args = (env, astate, _aval(tables), _aval(active), atoks,
+                    aq, akey)
+        else:
+            args = (env, astate, atoks, aq, akey)
+        return probe_artifact(
+            self, self._verify_fn, args, name,
+            refine=self._refine_pallas_meta, donated_leaves=donated,
+            mesh_shape=dict(self._mesh.shape)
+            if self._mesh is not None else None,
+            trace_count=self.trace_counts["verify"],
+            expected_traces=max(len(self._verify_shapes), 1),
+            cache_len=self._cache_len, spec_k=int(k),
+            **self._cache_meta(state, fn=self._verify_fn))
 
 
 def _build_insert_fn():
@@ -1783,6 +1997,7 @@ class DecodeServer:
         self._preempt_cb = None     # serve.fleet routes records back out
         self._verify_restore = False   # tests: assert restore bit-parity
         self._ps = None             # persistent paged session (tick API)
+        self.aot_report = None      # serve_open's AOT readiness report
         self.swap_outs = 0
         self.swap_ins = 0
         self._bind_host_metrics(self._host)
@@ -2135,6 +2350,35 @@ class DecodeServer:
             return self._ps
         pred = self._pred
         slots = self._slots
+        # AOT cold start (MXNET_AOT): before the first request, load
+        # every serving program's serialized executable from the
+        # content-addressed program cache (or compile-and-save on a
+        # miss) — host readiness becomes a deserialize, and the loaded
+        # programs serve with zero traces (docs/programs.md)
+        from .programs import aot as _aot
+
+        if _aot.enabled() and getattr(pred, "_paged", False) \
+                and pred._mesh is None:
+            # a proposer that supplies draft PROBABILITIES (a non-greedy
+            # draft model) gives verify a different signature than the
+            # deterministic-proposer one prepared here — leave verify on
+            # the JIT path then, instead of arming an executable every
+            # verify step would mismatch into a fallback
+            prop = self._proposer
+            probs_prop = getattr(prop, "predictor", None) is not None \
+                and not prop.predictor._greedy
+            self.aot_report = pred.prepare_programs(
+                slots, chunk_w=self._chunk_w,
+                spec_k=0 if probs_prop else self._spec_k)
+        elif _aot.enabled():
+            import logging
+
+            logging.getLogger(__name__).info(
+                "MXNET_AOT is armed but this server's predictor is %s; "
+                "AOT preparation covers paged single-host predictors "
+                "only (docs/programs.md) — keeping the JIT path",
+                "mesh-sharded" if getattr(pred, "_mesh", None) is not None
+                else "dense (non-paged)")
         self._ps = {
             "key": jax.random.PRNGKey(self._seed),
             "state": pred.paged_batch_state(slots),

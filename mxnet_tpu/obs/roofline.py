@@ -181,6 +181,11 @@ class ProgramAccounting:
                 # scatter) — the column that prices the two
                 # MXNET_MOE_DISPATCH algorithms against each other
                 row["sort_scatter_bytes"] = cost["sort_scatter_bytes"]
+            if cost.get("aot"):
+                # programs dispatching an AOT-deserialized (or AOT-
+                # compiled) executable carry their provenance — the
+                # cold-start story made visible per program
+                row["aot"] = cost["aot"]
             if cost.get("update_path"):
                 # the opt_update row: which update path is armed, plus
                 # both paths' priced bytes so the fused-vs-per-param
@@ -225,6 +230,8 @@ def render_mfu_table(rows):
         cols = cols + ("gather_bytes",)
     if any(r.get("sort_scatter_bytes") for r in rows):
         cols = cols + ("sort_scatter_bytes",)
+    if any(r.get("aot") for r in rows):
+        cols = cols + ("aot",)
     table = [[str(c) for c in cols]]
     for r in rows:
         table.append([_fmt(r.get(c)) for c in cols])

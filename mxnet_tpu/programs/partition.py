@@ -1,0 +1,113 @@
+"""Regex partition rules over named param trees.
+
+The fmengine ``match_partition_rules`` idiom: a sharding plan is a list
+of ``(regex, PartitionSpec)`` rules matched against parameter NAMES,
+not a hand-built per-leaf pspec tree.  This is the ONE pspec path for
+registered programs — the decode parameter placement funnels its
+Megatron graph-walk plan through :func:`rules_from_plan` +
+:func:`build_shardings`, and a user-supplied rule list (e.g.
+``[("ffn.*weight", P("model", None)), (".*", P())]``) drops into the
+same matcher.
+
+Degrade semantics match the placement code this replaces: a rule whose
+spec rank differs from the leaf's, or whose sharded dims don't divide
+by the mesh axis, REPLICATES that leaf instead of failing — checkpoint
+shapes vary, placement must not.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+__all__ = ["match_partition_rules", "build_shardings", "rules_from_plan"]
+
+
+def _as_spec(spec):
+    from jax.sharding import PartitionSpec as P
+
+    return spec if isinstance(spec, P) else P(*spec)
+
+
+def _exact_table(rules):
+    """``{literal_name: spec}`` when EVERY rule is an exact-name anchor
+    (``^<re.escape(name)>$`` — what :func:`rules_from_plan` emits), else
+    None.  Exact plans then match by one dict lookup per leaf instead
+    of scanning P regexes for each of P params — the graph-walk plan's
+    O(P) cost must not become O(P^2) for riding the regex front door.
+    First rule wins, like the scan."""
+    table = {}
+    for patt, spec in rules or ():
+        if not (isinstance(patt, str) and patt.startswith("^")
+                and patt.endswith("$")):
+            return None
+        body = patt[1:-1]
+        literal = re.sub(r"\\(.)", r"\1", body)
+        if re.escape(literal) != body:
+            return None
+        table.setdefault(literal, spec)
+    return table
+
+
+def match_partition_rules(rules, named_leaves, default=()):
+    """``{name: PartitionSpec}`` via first-matching regex per name.
+
+    ``named_leaves`` maps parameter names to shape-bearing leaves
+    (arrays or avals).  Scalars and single-element leaves always
+    replicate; an unmatched name takes ``default`` (replicated unless
+    told otherwise).  ``re.search`` semantics, like fmengine — anchor
+    with ``^...$`` for exact names (:func:`rules_from_plan` does).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    exact = _exact_table(rules)
+    out = {}
+    for name, leaf in named_leaves.items():
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            out[name] = P()
+            continue
+        if exact is not None:
+            hit = exact.get(name)
+            out[name] = _as_spec(hit if hit is not None else default)
+            continue
+        for patt, spec in rules or ():
+            if re.search(patt, name) is not None:
+                out[name] = _as_spec(spec)
+                break
+        else:
+            out[name] = _as_spec(default)
+    return out
+
+
+def build_shardings(mesh, rules, named_leaves, default=()):
+    """``{name: NamedSharding}`` for a named param tree under ``mesh``.
+
+    Applies :func:`match_partition_rules`, then the divisibility guard:
+    a matched spec is honored only when its rank equals the leaf's and
+    every sharded dim divides by its mesh axis size — otherwise the
+    leaf replicates (the same degrade rule the decode placement has
+    always used, now in the one shared matcher)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sizes = dict(mesh.shape)
+    specs = match_partition_rules(rules, named_leaves, default)
+    out = {}
+    for name, leaf in named_leaves.items():
+        spec = specs[name]
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        ok = len(spec) == len(shape) and all(
+            ax is None or shape[d] % sizes.get(ax, 1) == 0
+            for d, ax in enumerate(spec))
+        out[name] = NamedSharding(mesh, spec if ok else P())
+    return out
+
+
+def rules_from_plan(plan):
+    """Exact-name regex rules from a ``{name: axis-tuple}`` plan — the
+    bridge that funnels the existing Megatron graph walk
+    (``parallel.tp_rules.plan_tensor_parallel``) through the one regex
+    matcher, so graph-derived and hand-written rules share a code
+    path."""
+    return [("^" + re.escape(name) + "$", tuple(spec))
+            for name, spec in (plan or {}).items()]

@@ -56,7 +56,23 @@ from .. import obs as _obs
 from .prefix_cache import chain_hash
 from .swap import SwapStore
 
-__all__ = ["FleetHost", "PrefillWorker", "Router", "match_chains"]
+__all__ = ["FleetHost", "PrefillWorker", "Router", "http_health",
+           "match_chains"]
+
+
+def http_health(url, timeout=1.0):
+    """Poll a remote host's ``/healthz`` endpoint (the
+    ``obs.MetricsServer`` liveness probe); False on any error or
+    non-200 — a dark host and a dead host read the same to the router."""
+    import urllib.request
+
+    if not url.rstrip("/").endswith("/healthz"):
+        url = url.rstrip("/") + "/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status == 200
+    except Exception:
+        return False
 
 
 def match_chains(prompt, chains):
@@ -95,11 +111,34 @@ class FleetHost:
     identical payload from the host's ``/metrics.json``
     (``mx_serve_summary``)."""
 
-    def __init__(self, name, server):
+    def __init__(self, name, server, health=None, health_grace=0):
         self.name = str(name)
         self.server = server
         self.alive = True
+        # liveness probe: a callable returning bool, or a base URL whose
+        # /healthz the router polls (http_health).  None = no probe —
+        # this host's `alive` only flips by operator hand, the pre-HA
+        # behavior.  health_grace = consecutive failed polls tolerated
+        # BEYOND the first before flipping dark (0 = flip immediately;
+        # production URL probes should set >= 1 so one timed-out scrape
+        # of a loaded-but-healthy host doesn't requeue its whole batch)
+        self.health = health
+        self.health_grace = int(health_grace)
+        self._health_fails = 0
         server._bind_host_metrics(self.name)
+
+    def healthz(self):
+        """One health poll: True/False from the probe, None when this
+        host has no probe configured."""
+        h = self.health
+        if h is None:
+            return None
+        if callable(h):
+            try:
+                return bool(h())
+            except Exception:
+                return False
+        return http_health(h)
 
     def summary(self):
         return self.server.serve_summary()
@@ -190,7 +229,7 @@ class Router:
     """
 
     def __init__(self, hosts, prefill_workers=(), policy="cache_aware",
-                 threshold=None):
+                 threshold=None, health_interval=None):
         from .. import config as _config
 
         if policy not in ("cache_aware", "round_robin"):
@@ -198,6 +237,17 @@ class Router:
         self.hosts = list(hosts)
         if not self.hosts:
             raise MXNetError("Router needs at least one host")
+        # tick-time health polling cadence (seconds): in-process callable
+        # probes are free and poll every tick; URL probes block up to
+        # their HTTP timeout, so a fleet with any URL-probed host rate-
+        # limits to once a second by default — a dark host must not
+        # throttle every surviving host's serving ticks behind a
+        # connect timeout
+        if health_interval is None:
+            health_interval = 1.0 if any(
+                isinstance(h.health, str) for h in self.hosts) else 0.0
+        self._health_interval = float(health_interval)
+        self._last_health = 0.0
         self.workers = list(prefill_workers)
         self.policy = policy
         self._threshold = float(
@@ -211,8 +261,15 @@ class Router:
         self._wrr = 0               # worker cursor
         self._affinity = {}         # first-page chain hash -> host name
         self._map = {}              # (host_name, host_rid) -> router rid
+        self._inflight = {}         # (host_name, host_rid) -> submission
+        # entry, kept until completion so a host that goes dark can have
+        # its in-flight requests requeued (at-least-once semantics)
         self.results = {}
         self.decisions = []         # (rid, host, matched_est, path)
+        self.host_flips = []        # (host, alive) health-driven flips
+        self._m_flips = _obs.registry.counter(
+            "mx_fleet_host_flips", "health-driven alive flips",
+            labels=("host", "to"))
         self._m_routed = _obs.registry.counter(
             "mx_fleet_routed", "requests routed to a decode host",
             labels=("host",))
@@ -311,6 +368,7 @@ class Router:
                                       priority=entry["prio"])
             host.server._req[hrid]["submit"] = entry["submit"]
         self._map[(host.name, hrid)] = entry["rid"]
+        self._inflight[(host.name, hrid)] = entry
         self._m_routed.labels(host=host.name).inc()
         self.decisions.append((entry["rid"], host.name, int(matched),
                                path))
@@ -320,10 +378,96 @@ class Router:
         return host
 
     # ------------------------------------------------------------------
+    # health-driven HA: /healthz polling flips `alive` and requeues a
+    # dark host's in-flight requests on the survivors
+    # ------------------------------------------------------------------
+    def poll_health(self):
+        """Poll every host that has a health probe and flip ``alive``
+        accordingly.  A host going DARK has its in-flight requests
+        (queued-on-host and mid-decode alike) requeued at the router —
+        they re-route to live hosts and restart from the prompt
+        (at-least-once: generated-so-far tokens on the dark host are
+        lost, tokens are only ever delivered once because the dead
+        host's result mapping is dropped).  A host whose probe recovers
+        flips back alive and rejoins routing.  Returns the
+        ``[(host, alive, requeued)]`` flips this poll made."""
+        flips = []
+        for host in self.hosts:
+            ok = host.healthz()
+            if ok is None:
+                continue
+            if ok:
+                host._health_fails = 0
+            else:
+                host._health_fails += 1
+            if host.alive and not ok \
+                    and host._health_fails > host.health_grace:
+                host.alive = False
+                n = self._requeue_host(host.name)
+                flips.append((host.name, False, n))
+                self.host_flips.append((host.name, False))
+                self._m_flips.labels(host=host.name, to="down").inc()
+                _obs.instant("host_down", cat="fleet",
+                             args={"host": host.name, "requeued": n})
+            elif not host.alive and ok:
+                host.alive = True
+                flips.append((host.name, True, 0))
+                self.host_flips.append((host.name, True))
+                self._m_flips.labels(host=host.name, to="up").inc()
+                _obs.instant("host_up", cat="fleet",
+                             args={"host": host.name})
+        return flips
+
+    def _requeue_host(self, name):
+        """Requeue every in-flight request of a dark host (original
+        submission entries, original submit timestamps — TTFT stays
+        honest) and drop its result mappings plus any cold-affinity
+        bindings, so chains rebind to a live host."""
+        n = 0
+        requeued = set()
+        for key in [k for k in self._map if k[0] == name]:
+            self._map.pop(key)
+            entry = self._inflight.pop(key, None)
+            if entry is not None:
+                self._queue.append(entry)
+                requeued.add(key[1])
+                n += 1
+        # a record the dark host preempted but that has not rehomed yet
+        # would otherwise be injected as an ORPHAN (its mapping is gone,
+        # its results unconsumable) while the requeued original also
+        # runs — consume the restore copy and its swap-store bill here
+        if requeued:
+            kept = deque()
+            while self._restores:
+                src, record = self._restores.popleft()
+                if src == name and record.rid in requeued:
+                    self.swap_store.pop((src, record.rid))
+                    continue
+                kept.append((src, record))
+            self._restores = kept
+        for head in [h for h, bound in self._affinity.items()
+                     if bound == name]:
+            del self._affinity[head]
+        return n
+
+    # ------------------------------------------------------------------
     def tick(self):
-        """One fleet iteration: route every pending submission and
-        preempted record, then advance each live host by one serving
-        iteration and collect finished results."""
+        """One fleet iteration: poll health (flipping ``alive`` and
+        requeuing a dark host's work; URL-probed fleets rate-limit the
+        poll — see ``health_interval``), route every pending submission
+        and preempted record, then advance each live host by one
+        serving iteration and collect finished results."""
+        now = time.time()
+        if now - self._last_health >= self._health_interval:
+            self._last_health = now
+            self.poll_health()
+        if (self._queue or self._restores) \
+                and not any(h.alive for h in self.hosts):
+            # fail loudly BEFORE popping anything: the queued entries
+            # and preempted records stay held, so a caller that catches
+            # this can wait for a health recovery and resume with
+            # nothing lost (previously the popped entry was dropped)
+            raise MXNetError("no live decode hosts")
         while self._queue:
             self.route(self._queue.popleft())
         while self._restores:
@@ -332,10 +476,13 @@ class Router:
             # cache match needed: pages restore as raw pool bytes
             host = min(self._alive(), key=lambda h: h.load())
             rr = self._map.pop((src_name, record.rid), None)
+            entry = self._inflight.pop((src_name, record.rid), None)
             self.swap_store.pop((src_name, record.rid))
             hrid = host.server.inject(record)
             if rr is not None:
                 self._map[(host.name, hrid)] = rr
+            if entry is not None:
+                self._inflight[(host.name, hrid)] = entry
             _obs.instant("rehome", cat="fleet",
                          args={"from": src_name, "host": host.name,
                                "pages": record.n_pages})
@@ -345,6 +492,7 @@ class Router:
                 done = host.server.serve_results(clear=True)
                 for hrid, toks in done.items():
                     rr = self._map.pop((host.name, hrid), None)
+                    self._inflight.pop((host.name, hrid), None)
                     if rr is not None:
                         self.results[rr] = toks
 
@@ -374,9 +522,11 @@ class Router:
         self._queue.clear()
         self._restores.clear()
         self._map.clear()
+        self._inflight.clear()
         self._affinity.clear()
         self.results = {}
         self.decisions = []
+        self.host_flips = []
         self._base_matched = self._m_matched.get()
         self._base_lookup = self._m_lookup.get()
         # cold-start THIS router's TTFT samples too, or stats() after a
@@ -419,6 +569,8 @@ class Router:
         out = {
             "policy": self.policy,
             "hosts": sorted(names),
+            "alive_hosts": sorted(h.name for h in self.hosts if h.alive),
+            "host_flips": list(self.host_flips),
             "routed_by_host": per_host("mx_fleet_routed"),
             "migrated_pages_by_host": per_host("mx_fleet_migrated_pages"),
             "swapped_pages_by_host": per_host("mx_fleet_swapped_pages"),

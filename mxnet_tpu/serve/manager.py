@@ -61,10 +61,8 @@ class PagedKVManager:
         self.capacity = int(capacity)
         self.slots = int(slots)
         self.pages_per_slot = self.capacity // self.page_tokens
-        if not pool_pages:
-            # capacity-complete default: every slot can fill its table
-            pool_pages = self.slots * self.pages_per_slot + 1
-        self.pool_pages = int(pool_pages)
+        self.pool_pages = self.pool_sizing(slots, capacity, page_tokens,
+                                           pool_pages)
         self.allocator = PageAllocator(self.pool_pages)
         self.prefix_cache = PrefixCache(self.page_tokens, self.allocator) \
             if prefix_cache else None
@@ -81,6 +79,18 @@ class PagedKVManager:
         # the prefix cache (or a matching slot) also references it —
         # only non-owned pages and wrap recycles fork
         self._own = np.zeros((self.slots, self.pages_per_slot), bool)
+
+    @staticmethod
+    def pool_sizing(slots, capacity, page_tokens, pool_pages=0):
+        """Resolved pool page count for a serving batch: the explicit
+        ``pool_pages`` when given, else the capacity-complete default
+        (every slot can fill its table, plus the scratch page).  ONE
+        rule shared with ``DecodePredictor.serving_avals`` so the
+        AOT-prepared program signatures can never drift from the pools
+        ``serve_open`` actually allocates."""
+        if not pool_pages:
+            return int(slots) * (int(capacity) // int(page_tokens)) + 1
+        return int(pool_pages)
 
     # ------------------------------------------------------------------
     def _alloc(self, slot):

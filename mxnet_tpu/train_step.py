@@ -83,6 +83,55 @@ def _weak_update_prober(step):
     return prober
 
 
+def _register_step_spec(step):
+    """Register a step's :class:`~mxnet_tpu.programs.spec.ProgramSpec`
+    with the process-wide program registry — name, donation map, lazy
+    abstract args and the retrace counters, registered ONCE per step
+    (the registry holds it weakly; the step owns it).  Works for both
+    :class:`CompiledTrainStep` (whose donation block widens under an
+    armed fused-update plan) and :class:`CompiledEvalStep` (donated
+    accumulator state only)."""
+    import weakref
+
+    from .programs import registry as _registry
+    from .programs.spec import ProgramSpec
+
+    ref = weakref.ref(step)
+    is_train = isinstance(step, CompiledTrainStep)
+
+    def abstract():
+        live = ref()
+        if live is None:
+            return None
+        if is_train:
+            return live._abstract_args(live._group)
+        return live._last_args
+
+    # the donation map is fixed at registration: a fused-update plan
+    # only arms in __init__, and registration happens at first run()
+    if is_train:
+        donate = (0, 1, 2, 3, 4) if step._plan is not None \
+            else (0, 1, 2, 3)
+    else:
+        donate = (2,)
+
+    spec = ProgramSpec(
+        step.telemetry_name, step._fn, owner=step,
+        abstract_args=abstract,
+        donate_argnums=donate,
+        compute_dtype=lambda: (str(ref()._cdtype)
+                               if ref() is not None and is_train
+                               and ref()._cdtype is not None else None),
+        mesh_shape=lambda: (dict(ref()._group._mesh.shape)
+                            if ref() is not None and is_train
+                            and ref()._group._mesh is not None else None),
+        trace_count=lambda: (ref().trace_count
+                             if ref() is not None else None),
+        expected_traces=lambda: (ref().programs_built
+                                 if ref() is not None and is_train else 1))
+    return _registry.register(spec)
+
+
 class CompiledEvalStep:
     """Forward-only executor program with device-side metric accumulation.
 
@@ -185,6 +234,7 @@ class CompiledEvalStep:
             self._static_registered = True
             _obs.programs.register_static(self.telemetry_name,
                                           _weak_prober(self))
+            self._program_spec = _register_step_spec(self)
         t0 = time.perf_counter()
         w0 = time.time()
         try:
@@ -263,37 +313,27 @@ class CompiledEvalStep:
         throwaway compile, trace flagged as non-counting."""
         import jax.tree_util as jtu
 
-        from .analysis.artifact import artifact_from_jit
+        from .programs.spec import probe_artifact
 
         if self._last_args is None:
             return None
         params, aux, mstate, data, rng = self._last_args
-        donated = len(jtu.tree_leaves(mstate))
-        count = self.trace_count
-        self._probing = True
-        try:
-            return artifact_from_jit(
-                self._fn, (params, aux, mstate, data, rng), name=name,
-                donated_leaves=donated, trace_count=count,
-                expected_traces=1,
-                metric=type(self._acc.metric).__name__)
-        finally:
-            self._probing = False
+        return probe_artifact(
+            self, self._fn, (params, aux, mstate, data, rng), name,
+            donated_leaves=len(jtu.tree_leaves(mstate)),
+            trace_count=self.trace_count, expected_traces=1,
+            metric=type(self._acc.metric).__name__)
 
     def roofline_static(self):
         """Static FLOPs + traffic bytes of the eval program at the
         last-run shapes (None before the first ``run``) — the lazy
         roofline join, trace+lower only, probe-flagged so it never
         counts as a retrace."""
-        from .analysis.cost import program_cost
+        from .programs.spec import probe_cost
 
         if self._last_args is None:
             return None
-        self._probing = True
-        try:
-            return program_cost(self._fn, self._last_args)
-        finally:
-            self._probing = False
+        return probe_cost(self, self._fn, self._last_args)
 
 
 class CompiledTrainStep:
@@ -695,6 +735,7 @@ class CompiledTrainStep:
             self._static_registered = True
             _obs.programs.register_static(self.telemetry_name,
                                           _weak_prober(self))
+            self._program_spec = _register_step_spec(self)
             # the optimizer phase's own row: zero wall of its own (its
             # dispatch is inside train_step), but its priced bytes make
             # the fused-vs-per-param HBM diet visible per program.  Keyed
@@ -887,16 +928,15 @@ class CompiledTrainStep:
         program (cached jit executables are keyed by concrete arrays, not
         avals), so this is a probe, not a free read.
         """
+        from .programs.spec import probing
+
         group = group if group is not None else self._group
         args = self._abstract_args(group)
         if args is None:
             return None
         fn = self._entry_for(group)
-        self._probing = True
-        try:
+        with probing(self):
             return fn.lower(*args).compile().as_text()
-        finally:
-            self._probing = False
 
     def artifact(self, name="train_step", group=None):
         """:class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` of the
@@ -905,7 +945,7 @@ class CompiledTrainStep:
         before the first ``run``)."""
         import jax.tree_util as jtu
 
-        from .analysis.artifact import artifact_from_jit
+        from .programs.spec import probe_artifact
 
         group = group if group is not None else self._group
         args = self._abstract_args(group)
@@ -916,44 +956,35 @@ class CompiledTrainStep:
         # mstate), plus the persistent compute slabs when the fused
         # Pallas update plan is armed
         ndon = 5 if self._plan is not None else 4
-        donated = len(jtu.tree_leaves(args[:ndon]))
         mesh_shape = dict(group._mesh.shape) if group._mesh is not None \
             else None
-        count, built = self.trace_count, self.programs_built
         # the artifact-level PATH_TAKEN tripwire, same contract as
         # decode's meta['pallas_decode']: a plan means the config
         # PROMISED the fused multi-tensor update kernel, and the
         # flop-dtype pass errors if no pallas_call lowered into the
         # program (a silent fallback to the per-parameter XLA chain)
-        self._probing = True
-        try:
-            return artifact_from_jit(
-                fn, args, name=name, donated_leaves=donated,
-                compute_dtype=str(self._cdtype) if self._cdtype is not None
-                else None,
-                mesh_shape=mesh_shape, trace_count=count,
-                expected_traces=built, num_steps=self.num_steps,
-                pallas_update=self._plan is not None)
-        finally:
-            self._probing = False
+        return probe_artifact(
+            self, fn, args, name,
+            donated_leaves=len(jtu.tree_leaves(args[:ndon])),
+            compute_dtype=str(self._cdtype) if self._cdtype is not None
+            else None,
+            mesh_shape=mesh_shape, trace_count=self.trace_count,
+            expected_traces=self.programs_built,
+            num_steps=self.num_steps,
+            pallas_update=self._plan is not None)
 
     def roofline_static(self, group=None):
         """Static FLOPs + traffic bytes of the fused step program at the
         live shapes (None before the first ``run``) — the lazy roofline
         join for ``obs.programs``.  Trace+lower only (no compile, no
         execution), probe-flagged so it never counts as a retrace."""
-        from .analysis.cost import program_cost
+        from .programs.spec import probe_cost
 
         group = group if group is not None else self._group
         args = self._abstract_args(group)
         if args is None:
             return None
-        fn = self._entry_for(group)
-        self._probing = True
-        try:
-            return program_cost(fn, args)
-        finally:
-            self._probing = False
+        return probe_cost(self, self._entry_for(group), args)
 
     def _place(self, arr, name, group=None):
         import jax

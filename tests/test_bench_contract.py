@@ -331,6 +331,66 @@ def test_bench_fleet_smoke_contract():
     assert rr_row["stats"]["worker_prefills"] == 0
 
 
+def test_bench_fleet_cold_start_smoke_contract():
+    """`benchmarks/bench_fleet.py --smoke --cold-start` measures fleet
+    program readiness: one build host populates the content-addressed
+    AOT program cache, each host then cold-starts by DESERIALIZING its
+    serving programs (mxnet_tpu.programs.aot) instead of
+    trace+lower+compiling them.  The bench asserts the deterministic
+    halves itself with nonzero exit — all-hit/zero-miss warm loads,
+    token identity of an AOT-served drain vs the plain JIT reference,
+    zero traces on the AOT host, and fingerprint equality between a
+    prefill worker's programs and the decode hosts' — and this smoke
+    re-pins them from the JSON.  The >= 3x readiness acceptance is
+    asserted by the bench's own full-dims run; wall-clock ratios at
+    smoke dims are REPORTED only (shared-machine noise)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    for key in [k for k in env if k.startswith("BENCH_")
+                or k.startswith("MXNET_FLEET_")
+                or k.startswith("MXNET_DECODE_")
+                or k.startswith("MXNET_SPEC_")
+                or k.startswith("MXNET_KV_")
+                or k in ("MXNET_AOT", "MXNET_PROGRAM_CACHE")]:
+        env.pop(key)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "bench_fleet.py"),
+         "--smoke", "--cold-start"],
+        capture_output=True, text=True, timeout=540, cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    head = json.loads(lines[0])
+    assert head["metric"].startswith("fleet_cold_start_s_h")
+    assert head["unit"] == "s"
+    # readiness wall clocks are present and positive; the ratio is
+    # reported at smoke dims, asserted >= 3.0 by the full-dims run
+    assert head["value"] > 0 and head["cold_start_s"] > 0
+    assert head["cold_start_jit_s"] > 0
+    assert head["cold_start_vs_jit"] == head["vs_baseline"] > 0
+    # the deterministic halves: every host's programs loaded from the
+    # cache (no warm-path misses, no signature fallbacks), the loaded
+    # executables served token-identically with zero retraces, and the
+    # worker's program fingerprints equal the hosts'
+    assert head["programs_loaded"] >= 6, head
+    assert head["aot_misses"] == 0, head
+    assert head["aot_hits"] == head["programs_loaded"] * head["hosts"]
+    assert head["aot_fallbacks"] == 0, head
+    assert head["token_identical"] is True, head
+    assert head["zero_retraces"] is True, head
+    assert head["worker_programs_identical"] is True, head
+
+    # stderr: the cold_start phase row with per-host wall clocks and
+    # all-cache sources
+    rows = [json.loads(ln) for ln in proc.stderr.splitlines()
+            if ln.strip().startswith("{")]
+    cold = next(r for r in rows if r.get("phase") == "cold_start")
+    assert len(cold["aot_wall_s"]) == head["hosts"]
+    assert set(cold["sources"].values()) == {"cache"}, cold
+
+
 def test_bench_moe_smoke_contract():
     """`benchmarks/bench_moe.py --smoke` drives the expert-parallel MoE
     LM fused step (explicit all-to-all dispatch over the 8-virtual-device

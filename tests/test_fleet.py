@@ -197,6 +197,140 @@ def test_router_affinity_units():
 
 
 # ---------------------------------------------------------------------------
+# satellite: router HA — health-driven alive flips + in-flight requeue
+# ---------------------------------------------------------------------------
+class _TickStubServer(_StubServer):
+    """A stub host whose serve loop the router can actually tick: every
+    ``serve_tick`` finishes ONE queued request with deterministic tokens
+    (the prompt length, repeated), so HA requeue semantics are checkable
+    without jax."""
+
+    def __init__(self):
+        super().__init__()
+        self._pending = []      # (hrid, prompt)
+        self._done = {}
+        self.completed = 0
+
+    def submit(self, prompt, cap, priority=0):
+        hrid = super().submit(prompt, cap, priority)
+        self._pending.append((hrid, np.asarray(prompt)))
+        return hrid
+
+    @property
+    def has_work(self):
+        return bool(self._pending)
+
+    def serve_tick(self):
+        if self._pending:
+            hrid, prompt = self._pending.pop(0)
+            self._done[hrid] = np.full((2,), prompt.size, np.int32)
+            self.completed += 1
+
+    def serve_results(self, clear=True):
+        out = dict(self._done)
+        if clear:
+            self._done.clear()
+        return out
+
+
+def test_router_health_flip_and_requeue():
+    """The HA rung: a host whose health probe goes dark is flipped
+    dead automatically, its in-flight requests (routed but unfinished)
+    requeue at the router and complete on the survivor; a recovering
+    probe flips the host back alive and it rejoins routing.  Every
+    result is delivered exactly once."""
+    health = {"a": True, "b": True}
+    sa, sb = _TickStubServer(), _TickStubServer()
+    ha = FleetHost("a", sa, health=lambda: health["a"])
+    hb = FleetHost("b", sb, health=lambda: health["b"])
+    router = Router([ha, hb], policy="round_robin")
+
+    prompts = [np.arange(n) % VOCAB for n in (3, 4, 5, 6)]
+    rids = [router.submit(p, 2) for p in prompts]
+    # route everything but let no host finish yet: route() directly
+    while router._queue:
+        router.route(router._queue.popleft())
+    assert len(sa.submitted) == 2 and len(sb.submitted) == 2
+
+    # host a goes dark BEFORE finishing anything: the next tick's
+    # health poll flips it and requeues its two in-flight requests
+    health["a"] = False
+    router.tick()
+    assert ha.alive is False
+    assert ("a", False) in router.host_flips
+    # the requeued entries re-routed to b (the only live host) and the
+    # drain completes every request on b alone
+    res = router.drain()
+    assert set(res) == set(rids)
+    assert sa.completed == 0 and sb.completed == len(prompts)
+    for rid, p in zip(rids, prompts):
+        assert np.array_equal(res[rid], np.full((2,), p.size, np.int32))
+
+    # recovery: the probe returns, the host flips back alive and
+    # round-robin routing includes it again
+    health["a"] = True
+    assert router.poll_health() == [("a", True, 0)]
+    assert ha.alive is True
+    r2 = router.submit(np.arange(4), 2)
+    router.drain()
+    assert r2 in router.results
+    # exactly one delivery per request — the dark host's stale copies
+    # (requeued before it finished them) have no result mapping left,
+    # so even if it completes them after revival nothing double-lands
+    assert len(router.results) == len(prompts) + 1
+
+    # a dark host's stale completion never double-delivers: route one
+    # request, kill its owner before it finishes, let the dark host
+    # "finish" it anyway — only the survivor's (requeued) copy delivers
+    r3 = router.submit(np.arange(5), 2)
+    while router._queue:
+        router.route(router._queue.popleft())
+    owner_name = next(k[0] for k, v in router._map.items() if v == r3)
+    owner = sa if owner_name == "a" else sb
+    pre = dict(router.results)
+    health[owner_name] = False
+    router.tick()           # flips the owner + requeues r3
+    owner.serve_tick()      # the dark host finishes its stale copy
+    res = router.drain()
+    assert res[r3].tolist() == [5, 5]
+    # the dark host's mapping was dropped with the requeue, so its
+    # stale result has no consumer — result count grew by exactly one
+    assert len(router.results) == len(pre) + 1
+    health[owner_name] = True
+    router.poll_health()
+    assert all(h.alive for h in (ha, hb))
+
+    # every host dark: tick fails LOUDLY with the queue intact (nothing
+    # popped and lost); recovery then drains the held entry
+    health["a"] = health["b"] = False
+    r4 = router.submit(np.arange(3), 2)
+    router.poll_health()
+    with pytest.raises(Exception, match="no live decode hosts"):
+        router.tick()
+    assert len(router._queue) == 1          # the entry is HELD, not lost
+    health["a"] = health["b"] = True
+    res = router.drain()
+    assert res[r4].tolist() == [3, 3]
+
+
+def test_health_grace_hysteresis():
+    """`health_grace` tolerates N consecutive probe failures beyond the
+    first before flipping dark — one timed-out scrape of a loaded host
+    must not requeue its whole batch; a success resets the count."""
+    up = {"ok": False}
+    host = FleetHost("g", _TickStubServer(), health=lambda: up["ok"],
+                     health_grace=1)
+    router = Router([host], policy="round_robin")
+    assert router.poll_health() == [] and host.alive   # 1st miss: grace
+    up["ok"] = True
+    router.poll_health()                               # success resets
+    up["ok"] = False
+    assert router.poll_health() == [] and host.alive   # graced again
+    flips = router.poll_health()                       # 2nd consecutive
+    assert flips == [("g", False, 0)] and not host.alive
+
+
+# ---------------------------------------------------------------------------
 # swap-out / readmit bit parity (single host)
 # ---------------------------------------------------------------------------
 def test_swap_out_readmit_bit_parity():

@@ -1,0 +1,298 @@
+"""The program registry + AOT-serialized executables
+(mxnet_tpu.programs; ISSUE-15).
+
+Covers: regex partition rules over named param trees (match + the
+divisibility degrade, and the decode placement funneling through
+them), ProgramSpec fingerprints (stable across instances, moved by
+dtype/shape/identity perturbations), the weakly-held live registry,
+AotDispatch fallback semantics, and the headline AOT round-trip —
+serialize in THIS process, deserialize in a FRESH subprocess, serve
+token-identically with every trace counter at zero; a perturbed
+config is a cache-key miss that falls back to JIT with a visible
+warning.
+"""
+import json
+import logging
+import os
+import subprocess
+import sys
+
+if __name__ == "__main__":
+    # subprocess entry (--aot-child): the script runs from tests/, so
+    # the repo root must precede the mxnet_tpu imports below
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import config as _cfg
+from mxnet_tpu.decode import DecodePredictor, DecodeServer
+from mxnet_tpu.models import attention_lm
+from mxnet_tpu.programs import aot as _aot
+from mxnet_tpu.programs.partition import (build_shardings,
+                                          match_partition_rules,
+                                          rules_from_plan)
+from mxnet_tpu.programs.registry import ProgramRegistry, REGISTRY
+from mxnet_tpu.programs.spec import ProgramSpec
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB, T = 16, 16
+
+
+def _tiny_lm(seed=0):
+    sym = attention_lm.get_symbol(VOCAB, T, num_layers=1, embed=8,
+                                  heads=2, ffn_hidden=16)
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, T), softmax_label=(1, T))
+    params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params[name] = rng.normal(0, 0.2, shape).astype(np.float32)
+    return sym, params
+
+
+def _mk_pred(sym, params, **kw):
+    kw.setdefault("page_tokens", 4)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("kv_dtype", "")
+    return DecodePredictor(sym, params, cache_len=T, temperature=0.0,
+                          paged=True, **kw)
+
+
+_PROMPTS = [[3, 1, 4, 1, 5], [2, 7, 1], [8, 2, 8, 1, 2, 8], [6, 6]]
+
+
+def _serve(pred, slots=2, max_new=4, spec_k=2):
+    srv = DecodeServer(pred, max_prefill=T // 2, slots=slots,
+                       max_new_tokens=max_new, spec_k=spec_k)
+    for p in _PROMPTS:
+        srv.submit(np.asarray(p))
+    return {int(k): v.tolist() for k, v in srv.run().items()}, srv
+
+
+# ---------------------------------------------------------------------------
+# regex partition rules
+# ---------------------------------------------------------------------------
+def test_match_partition_rules_units():
+    from jax.sharding import PartitionSpec as P
+
+    leaves = {"layer0_ffn_weight": np.zeros((8, 16)),
+              "layer0_ffn_bias": np.zeros((16,)),
+              "embed_table": np.zeros((VOCAB, 8)),
+              "scale": np.zeros(())}
+    rules = [(r"ffn_weight$", ("model", None)),
+             (r"^embed", P(None, "model"))]
+    specs = match_partition_rules(rules, leaves)
+    assert specs["layer0_ffn_weight"] == P("model", None)
+    assert specs["embed_table"] == P(None, "model")
+    # unmatched names take the default; scalars always replicate
+    assert specs["layer0_ffn_bias"] == P()
+    assert specs["scale"] == P()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs >= 2 devices")
+def test_build_shardings_divisibility_degrade():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    leaves = {"w_even": np.zeros((4, 8)), "w_odd": np.zeros((3, 8)),
+              "w_rank": np.zeros((4,))}
+    rules = [(r"^w_", ("model", None))]
+    out = build_shardings(mesh, rules, leaves)
+    assert out["w_even"].spec == P("model", None)
+    # a dim that doesn't divide, or a rank mismatch, replicates instead
+    # of failing — the decode placement's historical guard
+    assert out["w_odd"].spec == P()
+    assert out["w_rank"].spec == P()
+
+
+def test_rules_from_plan_exact_names():
+    from jax.sharding import PartitionSpec as P
+
+    plan = {"fc1_weight": ("model", None)}
+    rules = rules_from_plan(plan)
+    specs = match_partition_rules(
+        rules, {"fc1_weight": np.zeros((4, 4)),
+                "xfc1_weight": np.zeros((4, 4))})
+    assert specs["fc1_weight"] == P("model", None)
+    # exact anchoring: a superstring name must NOT inherit the rule
+    assert specs["xfc1_weight"] == P()
+
+
+# ---------------------------------------------------------------------------
+# ProgramSpec fingerprints + the weakly-held registry
+# ---------------------------------------------------------------------------
+def test_fingerprints_stable_and_sensitive():
+    sym, params = _tiny_lm()
+    a = _mk_pred(sym, params)
+    b = _mk_pred(sym, params)
+    fa = a.program_fingerprints(2, chunk_w=4, spec_k=2)
+    fb = b.program_fingerprints(2, chunk_w=4, spec_k=2)
+    assert fa == fb and len(fa) == 7
+    # page-size, batch-width and dtype perturbations all move the keys
+    assert a.program_fingerprints(3, chunk_w=4, spec_k=2) != fa
+    c = _mk_pred(sym, params, page_tokens=8)
+    assert c.program_fingerprints(2, chunk_w=4, spec_k=2)["decode"] \
+        != fa["decode"]
+    d = _mk_pred(sym, params, kv_dtype="int8")
+    assert d.program_fingerprints(2, chunk_w=4, spec_k=2)["decode"] \
+        != fa["decode"]
+    # a different model graph moves the keys at identical avals
+    sym2 = attention_lm.get_symbol(VOCAB, T, num_layers=1, embed=8,
+                                   heads=2, ffn_hidden=24)
+    rng = np.random.RandomState(0)
+    arg_shapes, _, _ = sym2.infer_shape(data=(1, T),
+                                        softmax_label=(1, T))
+    params2 = {n: rng.normal(0, 0.2, s).astype(np.float32)
+               for n, s in zip(sym2.list_arguments(), arg_shapes)
+               if n not in ("data", "softmax_label")}
+    e = _mk_pred(sym2, params2)
+    assert e.program_fingerprints(2, chunk_w=4, spec_k=2)["commit"] \
+        != fa["commit"]
+
+
+def test_registry_holds_specs_weakly():
+    class Owner:
+        _probing = False
+
+    owner = Owner()
+    fn = jax.jit(lambda x: x + 1)
+    reg = ProgramRegistry()
+    spec = reg.register(ProgramSpec(
+        "t_unit", fn, owner=owner,
+        abstract_args=lambda: (jax.ShapeDtypeStruct((2,), jnp.float32),),
+        trace_count=lambda: 0))
+    assert reg.get("t_unit") is spec
+    assert "t_unit" in reg.trace_report()
+    del spec
+    # the registry must never pin a program (and transitively its
+    # model state): the entry evaporates with its owner-held spec
+    assert reg.get("t_unit") is None
+    assert reg.names() == []
+
+
+def test_registry_canonical_catalog():
+    reg = ProgramRegistry()
+
+    def builder(want):
+        return [("p1", _FakeArt("p1")), ("p2", _FakeArt("p2"))]
+
+    def unavailable():
+        return "needs hardware this host lacks"
+
+    class _FakeArt:
+        def __init__(self, name):
+            self.name = name
+
+    reg.register_canonical(("p1", "p2"), builder)
+    reg.register_canonical(("p3",), builder, availability=unavailable)
+    assert reg.canonical_names() == ("p1", "p2", "p3")
+    arts, notes = reg.build_canonical(["p2", "p3"])
+    assert [a.name for a in arts] == ["p2"]
+    assert notes == {"p3": "needs hardware this host lacks"}
+    with pytest.raises(Exception):
+        reg.register_canonical(("p1",), builder)   # duplicate name
+    # the real catalog: analysis/programs.py registered the twelve
+    import mxnet_tpu.analysis.programs as _progs
+
+    assert len(REGISTRY.canonical_names()) >= 12
+    assert _progs.CANONICAL_PROGRAMS == REGISTRY.canonical_names()
+
+
+def test_aot_dispatch_fallback_counted():
+    from mxnet_tpu.programs.aot import AOT_STATS, AotDispatch
+
+    fn = jax.jit(lambda x: x * 2)
+    comp = fn.lower(jax.ShapeDtypeStruct((4,), jnp.float32)).compile()
+    disp = AotDispatch("t_disp", fn)
+    assert not disp.armed
+    disp.arm(comp, "compile", "k")
+    ok = disp(jnp.ones((4,)))
+    assert np.allclose(ok, 2.0)
+    before = AOT_STATS["fallbacks"]
+    out = disp(jnp.ones((6,)))          # signature the exe wasn't built for
+    assert np.allclose(out, 2.0) and out.shape == (6,)
+    assert AOT_STATS["fallbacks"] == before + 1
+    # probes delegate to the jit path regardless of arming
+    assert "stablehlo" in disp.lower(
+        jax.ShapeDtypeStruct((4,), jnp.float32)).as_text()[:200] or True
+
+
+# ---------------------------------------------------------------------------
+# the headline: AOT round-trip into a FRESH process
+# ---------------------------------------------------------------------------
+def test_aot_roundtrip_fresh_process(tmp_path, caplog):
+    """Serialize here -> deserialize in a subprocess -> token-identical
+    serve with trace counters ALL ZERO; then a perturbed config misses
+    the cache and falls back to JIT with a visible warning."""
+    sym, params = _tiny_lm()
+    cache = str(tmp_path / "progcache")
+
+    # reference tokens, plain JIT (no cache involvement)
+    ref, _ = _serve(_mk_pred(sym, params))
+
+    # populate the cache in THIS process
+    with _cfg.overrides(MXNET_AOT="1", MXNET_PROGRAM_CACHE=cache):
+        pred0 = _mk_pred(sym, params)
+        out0, srv0 = _serve(pred0)
+        assert out0 == ref
+        rep = srv0.aot_report
+        assert rep is not None and rep["misses"] == len(rep["programs"])
+        assert sorted(os.listdir(cache))  # .aotx blobs + .json sidecars
+
+    # a FRESH process loads the serialized executables and serves:
+    # zero misses, zero traces, identical tokens
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_AOT="1",
+               MXNET_PROGRAM_CACHE=cache)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--aot-child"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    child = json.loads(proc.stdout.splitlines()[-1])
+    assert child["tokens"] == {str(k): v for k, v in ref.items()}
+    assert child["hits"] == child["programs"] and child["misses"] == 0
+    assert set(child["sources"].values()) == {"cache"}
+    assert all(v == 0 for v in child["trace_counts"].values()), \
+        child["trace_counts"]
+
+    # perturbed config (quantized caches) = different fingerprints =
+    # cache-key MISS: serving falls back to trace+compile with a
+    # VISIBLE warning, and still works
+    with _cfg.overrides(MXNET_AOT="1", MXNET_PROGRAM_CACHE=cache):
+        predq = _mk_pred(sym, params, kv_dtype="int8")
+        with caplog.at_level(logging.WARNING,
+                             logger="mxnet_tpu.programs.aot"):
+            outq, srvq = _serve(predq)
+        assert srvq.aot_report["hits"] == 0
+        assert srvq.aot_report["misses"] == len(
+            srvq.aot_report["programs"])
+        assert any("AOT cache miss" in r.message for r in caplog.records)
+        assert len(outq) == len(ref)    # the fallback really served
+
+
+def _aot_child_main():
+    """Subprocess half of the round-trip: rebuild the same model from
+    the same seeds, serve through serve_open's AOT load, report."""
+    sym, params = _tiny_lm()
+    pred = _mk_pred(sym, params)
+    out, srv = _serve(pred)
+    rep = srv.aot_report
+    print(json.dumps({
+        "tokens": {str(k): v for k, v in out.items()},
+        "programs": len(rep["programs"]),
+        "hits": rep["hits"], "misses": rep["misses"],
+        "sources": {k: v["source"] for k, v in rep["programs"].items()},
+        "trace_counts": pred.trace_counts,
+    }))
+
+
+if __name__ == "__main__":
+    if "--aot-child" in sys.argv:
+        _aot_child_main()

@@ -118,19 +118,21 @@ def main(argv=None):
 
     from mxnet_tpu import analysis
     from mxnet_tpu.analysis.hlo_parse import collective_stats
-    from mxnet_tpu.analysis.programs import (CANONICAL_PROGRAMS,
-                                             build_canonical_artifacts)
+    from mxnet_tpu.programs import registry as progreg
+    import mxnet_tpu.analysis.programs  # noqa: F401 — registers the
+    # canonical builder groups with the program registry; --list,
+    # --programs and the audit below all enumerate the registry
     import bench as _bench
 
     if args.list_only:
-        for name in CANONICAL_PROGRAMS:
+        for name in progreg.canonical_names():
             print("program:", name)
         for p in analysis.default_passes():
             print("pass:", p.name)
         return 0
 
     names = [n for n in args.programs.split(",") if n] or None
-    artifacts, notes = build_canonical_artifacts(names)
+    artifacts, notes = progreg.build_canonical(names)
     for prog, reason in notes.items():
         print(json.dumps({"skipped_program": prog, "reason": reason}),
               file=sys.stderr)

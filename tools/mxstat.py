@@ -22,7 +22,9 @@ Usage:
   (``opt_update_bytes``, ``all_to_all_bytes``, ``dispatch_bytes``),
   the fleet headline fields (``bench_fleet.py``: ``p95_ttft_ms``,
   ``router_cache_hit_rate``, ``vs_round_robin``, migrated/swapped page
-  counts) and a per-program join of the two ``mfu_table``s (bytes,
+  counts, and the ``--cold-start`` contract's ``cold_start_s`` /
+  ``cold_start_vs_jit`` / ``aot_*`` program-readiness fields) and a
+  per-program join of the two ``mfu_table``s (bytes,
   flops, wall_s, mfu), with absolute and percent deltas — the perf
   trajectory across PRs as one readable table instead of two
   hand-diffed JSON blobs.
@@ -122,7 +124,12 @@ def _render_diff_table(rows):
 
 _EXTRA_SUFFIXES = (".ratio", ".count", "_ms", "_rate", "_pages",
                    "_outs", "_prefills", "_tokens_per_sec",
-                   "vs_round_robin")
+                   "vs_round_robin",
+                   # the bench_fleet.py --cold-start contract: per-host
+                   # program readiness, warm AOT cache vs trace+compile
+                   "cold_start_s", "cold_start_jit_s", "cold_start_vs_jit",
+                   "aot_hits", "aot_misses", "aot_fallbacks",
+                   "programs_loaded")
 
 
 def _flatten_bytes_extras(obj, prefix=""):
