@@ -15,14 +15,29 @@ E) pool is timed with the pool ``device_put`` under each candidate
 to every pool at allocation (``ops.attention.apply_kv_layout``).
 Backends that refuse a layout request (XLA:CPU) report it and keep the
 native row-major; the knob is then best left empty.
+
+Output contract: ONE bench.contract_line json per probed layout on
+stdout (winner flagged with ``"winner": true``); the human-readable
+table goes to stderr.  The ``--kv`` winner is also INGESTED into the
+persistent tuning cache (:mod:`mxnet_tpu.ops.tuning`, op
+``"kv_layout"``), where :func:`mxnet_tpu.ops.attention.apply_kv_layout`
+consults it whenever ``MXNET_KV_LAYOUT`` is unset — probe once on the
+bench chip, every later process on the same device generation places
+its pools with the winning layout.
 """
 import functools
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench as _bench
 
 # (in_ch, out_ch, spatial, stride, n_blocks) rough resnet50 stage shapes
 STAGES = [
@@ -120,7 +135,8 @@ def bench(mode, iters=10):
         g = grad(params, x)
     fence(g)
     dt = (time.time() - tic) / iters
-    print("%-10s %7.2f ms/step  %7.1f img/s" % (mode, dt * 1e3, BATCH / dt))
+    print("%-10s %7.2f ms/step  %7.1f img/s" % (mode, dt * 1e3, BATCH / dt),
+          file=sys.stderr)
     return dt
 
 
@@ -145,14 +161,12 @@ def bench_kv(iters=30):
     the einsum path both stream).  The SAME jitted program runs for every
     candidate; only the pool's device layout changes, so the delta IS the
     layout.  Prints the winner as an ``export MXNET_KV_LAYOUT=...`` line
-    (empty = native wins or the backend refuses overrides)."""
-    import os
-    import sys
-
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-    import numpy as np
-
+    (empty = native wins or the backend refuses overrides), emits one
+    contract_line json per candidate on stdout, and ingests the winner
+    into the persistent tuning cache (op ``"kv_layout"``) so
+    ``apply_kv_layout`` finds it with the knob unset."""
     from mxnet_tpu.ops import attention as attn
+    from mxnet_tpu.ops import tuning
 
     b, t_cache, e, heads, pt = 8, 2048, 1024, 8, 16
     m = t_cache // pt
@@ -177,7 +191,7 @@ def bench_kv(iters=30):
             kpl, vpl = _kv_place(kp, order), _kv_place(vp, order)
         except Exception as exc:
             print("%-8s unsupported on this backend (%s)"
-                  % (name, str(exc)[:80]))
+                  % (name, str(exc)[:80]), file=sys.stderr)
             continue
         out = fn(q, kpl, vpl, table, lens)
         float(jnp.sum(out))                       # sync fence
@@ -186,23 +200,47 @@ def bench_kv(iters=30):
             out = fn(q, kpl, vpl, table, lens)
         float(jnp.sum(out))
         dt = (time.time() - tic) / iters
+        gbps = 2 * pages * pt * e * 4 / dt / 1e9
         print("%-8s %8.3f ms/step  %8.1f GB/s pool-stream"
-              % (name, dt * 1e3,
-                 2 * pages * pt * e * 4 / dt / 1e9))
-        results.append((dt, name))
+              % (name, dt * 1e3, gbps), file=sys.stderr)
+        results.append((dt, name, gbps))
     if results:
-        best = min(results)[1]
-        print("winner: %s" % best)
+        base_dt = results[0][0]
+        best_dt, best, _ = min(results)
+        for dt, name, gbps in results:
+            print(_bench.contract_line(
+                "kv_layout_%s_ms" % name.replace(",", ""),
+                round(dt * 1e3, 4), "ms", round(base_dt / dt, 3),
+                layout=name, pool_stream_gbps=round(gbps, 1),
+                winner=name == best))
+        print("winner: %s" % best, file=sys.stderr)
         print("export MXNET_KV_LAYOUT=%s"
-              % ("" if best == "native" else best))
+              % ("" if best == "native" else best), file=sys.stderr)
+        # ingest: apply_kv_layout consults this entry whenever the knob
+        # is unset, keyed by pool rank + dtype on this device generation
+        key = tuning.put(
+            "kv_layout", tuning.shape_class_for(rank=kp.ndim),
+            kp.dtype.name,
+            {"kv_layout": "" if best == "native" else best},
+            version=1,
+            extra={"probed": [{"layout": n, "ms": round(d * 1e3, 4)}
+                              for d, n, _ in results]})
+        print("tuning cache: kv_layout winner persisted (%s)" % key,
+              file=sys.stderr)
 
 
 if __name__ == "__main__":
-    import sys
-
-    print("device:", jax.devices()[0].device_kind)
+    print("device:", jax.devices()[0].device_kind, file=sys.stderr)
     if "--kv" in sys.argv:
         bench_kv()
     else:
-        for mode in ("nchw", "nhwc_wrap", "nhwc_full"):
-            bench(mode)
+        timings = [(bench(mode), mode)
+                   for mode in ("nchw", "nhwc_wrap", "nhwc_full")]
+        base_dt = timings[0][0]
+        best = min(timings)[1]
+        for dt, mode in timings:
+            print(_bench.contract_line(
+                "conv_layout_%s_ms" % mode, round(dt * 1e3, 2), "ms",
+                round(base_dt / dt, 3), layout=mode,
+                images_per_sec=round(BATCH / dt, 1),
+                winner=mode == best))

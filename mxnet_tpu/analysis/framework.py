@@ -181,12 +181,14 @@ def _is_suppressed(finding, triples):
 
 
 def default_passes():
-    """Fresh instances of the six shipped passes, in run order."""
+    """Fresh instances of the seven shipped passes, in run order."""
     from .passes import (CacheBytesPass, CollectiveBudgetPass, DonationPass,
-                         FlopDtypePass, HostSyncPass, RetracePass)
+                         FlopDtypePass, HostSyncPass, RetracePass,
+                         TunerCoveragePass)
 
     return [DonationPass(), CollectiveBudgetPass(), RetracePass(),
-            HostSyncPass(), FlopDtypePass(), CacheBytesPass()]
+            HostSyncPass(), FlopDtypePass(), CacheBytesPass(),
+            TunerCoveragePass()]
 
 
 _SURFACE_ATTR = {"jaxpr": "jaxpr_text", "stablehlo": "stablehlo_text",

@@ -7,7 +7,7 @@ optimizer updates.  Contrib (detection / CTC / fft) and RNN register from
 their own modules as they land.
 """
 from . import (elemwise, tensor, nn, sample, optimizer_ops, rnn_op, spatial,
-               contrib_ops, attention, moe)
+               contrib_ops, attention, moe, fused_lm)
 
 _registered = False
 
@@ -27,6 +27,7 @@ def register_all():
     contrib_ops.register_all()
     attention.register_all()
     moe.register_all()
+    fused_lm.register_all()
 
 
 register_all()

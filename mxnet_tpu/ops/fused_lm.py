@@ -1,0 +1,237 @@
+"""FusedLNLinear — the LM training path's LN->linear segment as ONE op.
+
+``models/attention_lm.py``'s pre-norm blocks are chains of exactly this
+segment: LayerNorm's affine tail (gamma/beta), an optional ReLU
+prologue, a FullyConnected, an optional residual add.  The stock graph
+runs it as five registry ops — five HBM round trips over (B*T, E)-class
+tensors on a bandwidth-bound model.  This op is the segment as one
+node, so the trace-time dispatch below can hand the WHOLE chain to the
+fused Pallas epilogue kernel (:mod:`~mxnet_tpu.ops.pallas_fused`,
+``wt=True`` — FullyConnected's (num_hidden, K) weight layout contracts
+in place): affine + ReLU ride the MXU operand load, bias + residual
+ride the epilogue, x read once, y written once, forward AND backward
+(the kernel is custom-VJP end to end, so ``train_step.py``'s compiled
+donated program runs it both ways).
+
+The LN *statistics* (mean/variance normalize) stay graph ops: they are
+a cheap per-row reduction XLA fuses well, and keeping them out makes
+the op a pure scale/shift->matmul — the exact kernel contract.
+
+Dispatch (the ``paged_attend`` idiom): ``MXNET_PALLAS_FUSED`` armed AND
+the backend can run it (TPU natively, anything else under
+``MXNET_PALLAS_INTERPRET``) AND the executor is not mesh-sharded
+(Pallas is GSPMD-opaque) AND :func:`pallas_fused.supported` accepts the
+(M, K, N, dtype).  Otherwise the einsum fallback composition — the same
+five-op math XLA sees today — with :data:`FUSED_PATH` recording which
+path traced ("pallas" / "einsum-gated" / "einsum") so tests pin the
+kernel actually running instead of silently regressing to 100%-einsum.
+
+Parameter names and shapes are checkpoint-identical to the unfused
+graph: gamma/beta keep their ``*_ln_gamma``/``*_ln_beta`` (1, 1, E)
+Variables, weight/bias keep FullyConnected's ``(num_hidden, K)`` /
+``(num_hidden,)``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..attrs import Param, ParamSchema
+from ..registry import OpDef, register_op
+
+# Which path the last FusedLNLinear dispatch traced — "pallas" when the
+# fused kernel traced, "einsum-gated" when armed but the shape gate
+# refused, "einsum" when the knob is off or the executor is
+# mesh-sharded.  Written at trace time (the PATH_TAKEN idiom of
+# ops/attention.py).
+FUSED_PATH = {"last": None}
+
+
+def fused_kernel_mode():
+    """``(engage, interpret)`` for the fused LN->linear kernel under the
+    current config and backend: engaged when ``MXNET_PALLAS_FUSED`` is
+    set AND the backend can run it (TPU natively, anything else only
+    under ``MXNET_PALLAS_INTERPRET``)."""
+    from .. import config as _config
+
+    if not _config.get("MXNET_PALLAS_FUSED"):
+        return False, False
+    import jax
+
+    interpret = bool(_config.get("MXNET_PALLAS_INTERPRET"))
+    on_tpu = jax.default_backend() == "tpu"
+    return (on_tpu or interpret), (interpret and not on_tpu)
+
+
+def _arg_names(attrs):
+    # residual sits BEFORE weight/bias: Symbol composition auto-creates
+    # missing trailing arguments as Variables, and callers pass the
+    # residual explicitly while weight/bias auto-create
+    args = ["data"]
+    if not attrs.get("no_affine", False):
+        args += ["gamma", "beta"]
+    if attrs.get("has_residual", False):
+        args.append("residual")
+    args += ["weight", "bias"]
+    return args
+
+
+def _flnl_shape(attrs, in_shapes, aux_shapes):
+    dshape = in_shapes[0]
+    nh = attrs["num_hidden"]
+    e = dshape[-1]
+    out = tuple(dshape[:-1]) + (nh,)
+    shapes = [dshape]
+    if not attrs.get("no_affine", False):
+        # LayerNorm's broadcast affine params, unchanged from the
+        # unfused graph's layer_norm Variables
+        shapes += [(1, 1, e), (1, 1, e)]
+    if attrs.get("has_residual", False):
+        shapes.append(out)
+    shapes += [(nh, e), (nh,)]
+    return shapes, [out], []
+
+
+def _flnl(attrs, inputs, aux, octx):
+    import jax.numpy as jnp
+
+    ins = list(inputs)
+    data = ins.pop(0)
+    gamma = beta = None
+    if not attrs.get("no_affine", False):
+        gamma = ins.pop(0)
+        beta = ins.pop(0)
+    residual = ins.pop(0) if attrs.get("has_residual", False) else None
+    weight = ins.pop(0)
+    bias = ins.pop(0)
+    relu = attrs.get("relu", False)
+
+    lead = data.shape[:-1]
+    k = data.shape[-1]
+    n = weight.shape[0]
+    m = 1
+    for s in lead:
+        m *= int(s)
+
+    engage, interp = fused_kernel_mode()
+    if engage and not octx.mesh_active:
+        from . import pallas_fused as pf
+
+        if pf.supported(m, k, n, data.dtype):
+            FUSED_PATH["last"] = "pallas"
+            scale = (gamma.reshape(-1).astype(jnp.float32)
+                     if gamma is not None else jnp.ones((k,), jnp.float32))
+            shift = (beta.reshape(-1).astype(jnp.float32)
+                     if beta is not None else jnp.zeros((k,), jnp.float32))
+            res2 = residual.reshape(m, n) if residual is not None else None
+            # the (N,) stats outputs ride the epilogue for free; this
+            # segment does not consume them, and their zero cotangents
+            # fold out of the backward
+            y, _s1, _s2 = pf.fused_scale_relu_matmul(
+                data.reshape(m, k), scale, shift, weight, residual=res2,
+                relu=relu, bias=bias, wt=True, interpret=interp)
+            return [y.reshape(lead + (n,))], list(aux)
+        FUSED_PATH["last"] = "einsum-gated"
+    else:
+        FUSED_PATH["last"] = "einsum"
+
+    # fallback: the unfused five-op composition, numerically the graph
+    # XLA ran before this op existed
+    a = data
+    if gamma is not None:
+        a = a * gamma.reshape(-1) + beta.reshape(-1)
+    if relu:
+        a = jnp.maximum(a, 0)
+    y = jnp.dot(a.reshape(m, k), weight.T) + bias
+    y = y.reshape(lead + (n,))
+    if residual is not None:
+        y = y + residual
+    return [y], list(aux)
+
+
+def register_all():
+    register_op(OpDef(
+        "FusedLNLinear", _flnl,
+        schema=ParamSchema(Param("num_hidden", int, required=True),
+                           Param("relu", bool, default=False),
+                           Param("no_affine", bool, default=False),
+                           Param("has_residual", bool, default=False)),
+        num_inputs=lambda a: len(_arg_names(a)),
+        arguments=_arg_names,
+        infer_shape=_flnl_shape, hint="fusedlnlinear",
+        doc="LayerNorm-affine -> (ReLU) -> linear (+bias) (+residual) as "
+            "one op; dispatches to the fused Pallas epilogue kernel "
+            "under MXNET_PALLAS_FUSED (einsum fallback otherwise)."))
+
+
+# ---------------------------------------------------------------------------
+# roofline pricing (the train_step prober's data source)
+# ---------------------------------------------------------------------------
+
+def _fused_nodes(step):
+    try:
+        exec_ = step._group.exec_
+        symbol = exec_._symbol
+    except AttributeError:
+        return None, []
+    nodes = [nd for nd in symbol._topo()
+             if nd.op is not None and nd.op.name == "FusedLNLinear"]
+    return exec_, nodes
+
+
+def step_has_fused_segments(step):
+    """Whether the step's graph contains FusedLNLinear nodes at all —
+    the train-step run() registers the lm_fused roofline row only then
+    (ResNet-class steps keep their tables clean)."""
+    return bool(_fused_nodes(step)[1])
+
+
+def priced_fused_cost_for_step(step):
+    """Aggregate :func:`pallas_fused.priced_fused_cost` over every
+    FusedLNLinear segment in a compiled step's graph, on the shapes the
+    step actually binds — `{"fused_path", "fused_kernel_bytes",
+    "fused_einsum_bytes", "segments"}`, or None for steps without fused
+    segments.  ``fused_path`` reflects the CURRENT knob/backend/shape
+    gate, so arming ``MXNET_PALLAS_FUSED`` visibly moves the row."""
+    import jax.numpy as jnp
+
+    from . import pallas_fused as pf
+
+    exec_, nodes = _fused_nodes(step)
+    if not nodes:
+        return None
+    try:
+        # every segment shares the flattened token count of the LM data
+        # batch (B, T): m = B*T
+        m = int(np.prod(exec_.arg_dict["data"].shape))
+    except (KeyError, AttributeError):
+        return None
+
+    engage, _ = fused_kernel_mode()
+    kernel_bytes = einsum_bytes = 0
+    all_supported = True
+    for nd in nodes:
+        attrs = nd.parsed_attrs()
+        args = _arg_names(attrs)
+        wnode = nd.inputs[args.index("weight")][0]
+        warr = exec_.arg_dict[wnode.name]
+        n, k = warr.shape
+        # the bound weight carries the step's compute dtype
+        dtype = jnp.dtype(warr.dtype)
+        priced = pf.priced_fused_cost(
+            m, int(k), int(n), dtype, relu=attrs.get("relu", False),
+            has_res=attrs.get("has_residual", False), has_bias=True,
+            interpret=True)
+        kernel_bytes += priced["fused_bytes"]
+        einsum_bytes += priced["einsum_bytes"]
+        if not pf.supported(m, int(k), int(n), dtype):
+            all_supported = False
+    if engage and all_supported:
+        path = "pallas"
+    elif engage:
+        path = "einsum-gated"
+    else:
+        path = "einsum"
+    return {"fused_path": path,
+            "fused_kernel_bytes": int(kernel_bytes),
+            "fused_einsum_bytes": int(einsum_bytes),
+            "segments": len(nodes)}

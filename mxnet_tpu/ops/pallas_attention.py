@@ -32,22 +32,43 @@ import functools
 
 import numpy as np
 
-# Block sizes swept on the bench chip (TPU v5 lite, T=2k-8k): fwd favors
-# small-Q/large-K streaming; bwd favors a fatter Q block that amortizes the
-# dQ/dK/dV accumulator read-modify-writes.
+# Block-size defaults for interpret/CPU mode (swept once on the bench
+# chip — TPU v5 lite, T=2k-8k: fwd favors small-Q/large-K streaming; bwd
+# favors a fatter Q block that amortizes the dQ/dK/dV accumulator
+# read-modify-writes).  On a live device the tuning cache
+# (ops/tuning.py) resolves per-(generation, shape-class, dtype) winners.
 BLOCK_Q = 128
 BLOCK_K = 512
 BLOCK_Q_BWD = 256
 BLOCK_K_BWD = 512
 LANES = 128
+MIN_BLOCK = 8
 
 
 def _pick_block(pref, t):
-    """Largest power-of-two shrink of ``pref`` that divides ``t``."""
+    """Largest power-of-two shrink of ``pref`` that divides ``t``, or 0
+    when the shrink degenerates below :data:`MIN_BLOCK` (odd/prime T
+    used to walk all the way to a pathological 1-row kernel, and a prime
+    T <= pref used to come back verbatim as a tile-misaligned full-T
+    block) — callers treat 0 as "unsupported, take the einsum path"."""
     b = min(pref, t)
-    while t % b:
+    b = 1 << (b.bit_length() - 1)   # power-of-two floor, never t itself
+    while b >= MIN_BLOCK and t % b:
         b //= 2
-    return b
+    return b if b >= MIN_BLOCK and t % b == 0 else 0
+
+
+def _tuned(t, d, dtype):
+    """Tuning-cache block resolution for this shape class ({"block_q",
+    "block_k", "block_q_bwd", "block_k_bwd"}; the module constants when
+    cold and no sweep armed)."""
+    import jax.numpy as jnp
+
+    from . import tuning
+
+    return tuning.resolve("pallas_attention",
+                          tuning.shape_class_for(t=t, d=d),
+                          jnp.dtype(dtype).name)
 
 
 def _out_sds(shape, dtype, *inputs):
@@ -154,15 +175,23 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
             lse_ref[0] = m_fin + jnp.log(d_fin)
 
 
-def _fwd_call(q, k, v, scale, causal, interpret, with_lse):
+def _fwd_call(q, k, v, scale, causal, interpret, with_lse, block_q=None,
+              block_k=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q.shape
-    bq = _pick_block(BLOCK_Q, t)
-    bk = _pick_block(BLOCK_K, t)
+    if block_q is None or block_k is None:
+        cfg = _tuned(t, d, q.dtype)
+        block_q = block_q or cfg.get("block_q", BLOCK_Q)
+        block_k = block_k or cfg.get("block_k", BLOCK_K)
+    bq = _pick_block(block_q, t)
+    bk = _pick_block(block_k, t)
+    if not bq or not bk:
+        raise ValueError("flash_attention fwd blocks degenerate for T=%d "
+                         "(callers must gate on supported())" % t)
     grid = (bh, t // bq, t // bk)
 
     kernel = functools.partial(_kernel, scale=scale, causal=causal,
@@ -307,15 +336,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dk_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret):
+def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret, block_q=None,
+              block_k=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q.shape
-    bq = _pick_block(BLOCK_Q_BWD, t)
-    bk = _pick_block(BLOCK_K_BWD, t)
+    if block_q is None or block_k is None:
+        cfg = _tuned(t, d, q.dtype)
+        block_q = block_q or cfg.get("block_q_bwd", BLOCK_Q_BWD)
+        block_k = block_k or cfg.get("block_k_bwd", BLOCK_K_BWD)
+    bq = _pick_block(block_q, t)
+    bk = _pick_block(block_k, t)
+    if not bq or not bk:
+        raise ValueError("flash_attention bwd blocks degenerate for T=%d "
+                         "(callers must gate on supported())" % t)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)
@@ -395,11 +432,36 @@ def _flash_vjp():
     return _flash
 
 
+def _einsum_fallback(q, k, v, scale, causal):
+    """Plain-XLA attention with the kernel's numerics contract, for
+    shapes whose blocks degenerate (odd/prime T); differentiable through
+    ordinary autodiff."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) \
+        .astype(q.dtype)
+
+
 def flash_attention(q, k, v, scale, causal=False, interpret=False):
     """(BH, T, D) q/k/v -> (BH, T, D) attention output.  Differentiable
     (custom_vjp over the backward kernels — training runs the flash path).
 
-    T must divide BLOCK_Q/BLOCK_K (the caller checks and falls back)."""
+    T whose block shrink degenerates below :data:`MIN_BLOCK` (odd or
+    prime T — formerly a pathological 1-row kernel) takes the einsum
+    fallback instead; tile-aligned T runs the kernels."""
+    t = q.shape[1]
+    if not (_pick_block(BLOCK_Q, t) and _pick_block(BLOCK_K, t)
+            and _pick_block(BLOCK_Q_BWD, t)
+            and _pick_block(BLOCK_K_BWD, t)):
+        return _einsum_fallback(q, k, v, float(scale), bool(causal))
     return _flash_vjp()(q, k, v, float(scale), bool(causal),
                         bool(interpret))
 
@@ -422,6 +484,12 @@ def supported(q_shape, k_shape, causal, num_heads=1):
         return False
     if (d // num_heads) % 64 != 0:     # lane-unfriendly heads: fallback
         return False
+    # degenerate block shrink (odd/prime T below the tile check above
+    # can't happen, but keep the gate self-sufficient for direct callers)
+    if not (_pick_block(BLOCK_Q, tq) and _pick_block(BLOCK_K, tq)
+            and _pick_block(BLOCK_Q_BWD, tq)
+            and _pick_block(BLOCK_K_BWD, tq)):
+        return False
     return True
 
 
@@ -440,3 +508,74 @@ def sdpa_flash(q, k, v, num_heads, causal, scale, interpret=False):
                           causal=bool(causal), interpret=bool(interpret))
     return out.reshape(b, num_heads, t, hd).transpose(0, 2, 1, 3) \
         .reshape(b, t, e)
+
+
+# ---------------------------------------------------------------------------
+# tunable space (ops/tuning.py): fwd/bwd Q/K blocks per shape class
+# ---------------------------------------------------------------------------
+
+def _tuning_candidates(shape_class, interpret):
+    if interpret:
+        # 2-candidate toy space: tier-1 exercises the sweep machinery on
+        # CPU without a grid search
+        return [{"block_q": 128, "block_k": 128},
+                {"block_q": 128, "block_k": 256}]
+    out = []
+    for bq in (128, 256):
+        for bk in (256, 512, 1024):
+            for bqb in (128, 256):
+                out.append({"block_q": bq, "block_k": bk,
+                            "block_q_bwd": bqb, "block_k_bwd": 512})
+    return out
+
+
+def _tuning_runner(params, shape_class, dtype, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    from . import tuning
+
+    dims = tuning.parse_shape_class(shape_class)
+    t, d = dims["t"], dims["d"]
+    for key in ("block_q", "block_k", "block_q_bwd", "block_k_bwd"):
+        if not _pick_block(params[key], t):
+            raise tuning.SpaceError("%s=%d degenerates for T=%d"
+                                    % (key, params[key], t))
+    dt = jnp.dtype(dtype)
+    rng = jax.random.PRNGKey(0)
+    bh = 4
+    q = jax.random.normal(rng, (bh, t, d), dt)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (bh, t, d), dt)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (bh, t, d), dt)
+    do = jnp.ones((bh, t, d), dt)
+    scale = 1.0 / float(np.sqrt(d))
+
+    bq, bk = params["block_q"], params["block_k"]
+    bqb, bkb = params["block_q_bwd"], params["block_k_bwd"]
+
+    @jax.jit
+    def probe(q, k, v, do):
+        o, lse = _fwd_call(q, k, v, scale, True, interpret, with_lse=True,
+                           block_q=bq, block_k=bk)
+        grads = _bwd_call(q, k, v, o, lse, do, scale, True, interpret,
+                          block_q=bqb, block_k=bkb)
+        return (o,) + tuple(grads)
+
+    def run():
+        jax.block_until_ready(probe(q, k, v, do))
+
+    return run
+
+
+def _register_space():
+    from . import tuning
+
+    tuning.register_space(
+        "pallas_attention", version=1,
+        defaults={"block_q": BLOCK_Q, "block_k": BLOCK_K,
+                  "block_q_bwd": BLOCK_Q_BWD, "block_k_bwd": BLOCK_K_BWD},
+        constants=("BLOCK_Q", "BLOCK_K", "BLOCK_Q_BWD", "BLOCK_K_BWD"),
+        candidates=_tuning_candidates, runner=_tuning_runner)
+
+
+_register_space()

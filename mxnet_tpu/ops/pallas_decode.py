@@ -75,13 +75,26 @@ _TPU_LANE = 128
 _TPU_SUBLANE = 8
 
 
-def _num_splits(m):
-    """Largest power-of-two split count <= min(m, MAX_SPLITS) that
-    divides m (1 when m is odd — the split axis degrades gracefully)."""
+def _num_splits(m, cap=None):
+    """Largest power-of-two split count <= min(m, cap) that divides m
+    (1 when m is odd — the split axis degrades gracefully).  ``cap``
+    defaults to the tuning cache's ``max_splits`` for this view width
+    (the :data:`MAX_SPLITS` constant when cold and no sweep armed)."""
+    if cap is None:
+        cap = _tuned_split_cap(m)
     s = 1
-    while s * 2 <= min(m, MAX_SPLITS) and m % (s * 2) == 0:
+    while s * 2 <= min(m, cap) and m % (s * 2) == 0:
         s *= 2
     return s
+
+
+def _tuned_split_cap(m):
+    from . import tuning
+
+    # split width is a parallelism knob, not a dtype-layout one: one
+    # decision per view width serves every pool dtype
+    return int(tuning.resolve("pallas_decode", tuning.shape_class_for(m=m),
+                              "any").get("max_splits", MAX_SPLITS))
 
 
 def _is_quant(pool):
@@ -201,7 +214,7 @@ def _kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 
 def _paged_flash_call(q, k_pool, v_pool, table, lens, num_heads, scale,
-                      interpret):
+                      interpret, split_cap=None):
     """Launch the kernel and combine split partials; returns (B, tq, Ev)
     in the V pool's compute dtype (f32 for quantized pools, matching the
     einsum path's dequantized output)."""
@@ -219,7 +232,7 @@ def _paged_flash_call(q, k_pool, v_pool, table, lens, num_heads, scale,
     hd_v = vd.shape[2] // h
     pt = kd.shape[1]
     m = table.shape[1]
-    s = _num_splits(m)
+    s = _num_splits(m, split_cap)
     ms = m // s
     scale = float(scale or 1.0 / np.sqrt(hd_k))
 
@@ -313,7 +326,7 @@ def _paged_flash_call(q, k_pool, v_pool, table, lens, num_heads, scale,
 
 
 def flash_sdpa_decode(q, k_pool, v_pool, table, total_len, num_heads=1,
-                      scale=None, interpret=False):
+                      scale=None, interpret=False, split_cap=None):
     """Fused paged decode attention: (B, 1, E) queries over (P, pt, E)
     pools through (B, M) page tables -> (B, 1, Ev).
 
@@ -324,11 +337,12 @@ def flash_sdpa_decode(q, k_pool, v_pool, table, total_len, num_heads=1,
     (token, head) in VMEM.  One HBM pass over the live pool pages.
     """
     return _paged_flash_call(q, k_pool, v_pool, table, total_len,
-                             num_heads, scale, interpret)
+                             num_heads, scale, interpret,
+                             split_cap=split_cap)
 
 
 def flash_sdpa_verify(q, k_pool, v_pool, table, total_len, num_heads=1,
-                      scale=None, interpret=False):
+                      scale=None, interpret=False, split_cap=None):
     """Fused paged multi-position cache attention — the speculative
     verify window (tq = k+1) and the chunked-prefill window (tq = chunk
     width) share it.  Query i masks to view slots
@@ -336,7 +350,8 @@ def flash_sdpa_verify(q, k_pool, v_pool, table, total_len, num_heads=1,
     each output row equals what a sequential decode chain would produce.
     """
     return _paged_flash_call(q, k_pool, v_pool, table, total_len,
-                             num_heads, scale, interpret)
+                             num_heads, scale, interpret,
+                             split_cap=split_cap)
 
 
 def _dense_block(c, pt_pref=128):
@@ -409,3 +424,60 @@ def dense_ring_attend(q, k_cache, v_cache, total_len, num_heads=1,
              + jnp.arange(mb, dtype=jnp.int32)[None, :])
     return _paged_flash_call(q, as_pool(k_cache), as_pool(v_cache), table,
                              total_len, num_heads, scale, interpret)
+
+
+# ---------------------------------------------------------------------------
+# tunable space (ops/tuning.py): split-K width per view-width class
+# ---------------------------------------------------------------------------
+
+def _tuning_candidates(shape_class, interpret):
+    if interpret:
+        # 2-candidate toy space for the tier-1 CPU sweep
+        return [{"max_splits": 2}, {"max_splits": 4}]
+    return [{"max_splits": c} for c in (1, 2, 4, 8, 16)]
+
+
+def _tuning_runner(params, shape_class, dtype, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    from . import tuning
+
+    m = tuning.parse_shape_class(shape_class).get("m", 8)
+    cap = params["max_splits"]
+    if cap > m:
+        raise tuning.SpaceError("max_splits %d exceeds view width m=%d"
+                                % (cap, m))
+    dt = jnp.float32 if dtype == "any" else jnp.dtype(dtype)
+    pt, e, b = 16, 128, 4
+    rng = jax.random.PRNGKey(0)
+    kp = jax.random.normal(rng, (b * m + 1, pt, e), dt)
+    vp = jax.random.normal(jax.random.fold_in(rng, 1), (b * m + 1, pt, e),
+                           dt)
+    q = jax.random.normal(jax.random.fold_in(rng, 2), (b, 1, e), dt)
+    table = (jnp.arange(b * m, dtype=jnp.int32).reshape(b, m) + 1)
+    lens = jnp.full((b,), m * pt, jnp.int32)
+
+    @jax.jit
+    def probe(q, kp, vp, table, lens):
+        # explicit split_cap: the sweep must not re-enter resolve()
+        return flash_sdpa_decode(q, kp, vp, table, lens, num_heads=1,
+                                 interpret=interpret, split_cap=cap)
+
+    def run():
+        jax.block_until_ready(probe(q, kp, vp, table, lens))
+
+    return run
+
+
+def _register_space():
+    from . import tuning
+
+    tuning.register_space(
+        "pallas_decode", version=1,
+        defaults={"max_splits": MAX_SPLITS},
+        constants=("MAX_SPLITS",),
+        candidates=_tuning_candidates, runner=_tuning_runner)
+
+
+_register_space()

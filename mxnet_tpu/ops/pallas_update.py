@@ -129,12 +129,17 @@ class UpdatePlan:
     slab.  Built once per step compile; all methods are traceable."""
 
     def __init__(self, kind, nslots, segments_by_bucket, compute_dtype,
-                 interpret):
+                 interpret, block_rows=BLOCK_ROWS):
         self.kind = kind
         self.nslots = nslots
         self.buckets = segments_by_bucket  # {dtype_name: [_Segment...]}
         self.cdtype = compute_dtype        # jnp dtype or None
         self.interpret = interpret
+        # grid-block height: the tuning cache's winner for this param
+        # population (plan_for resolves it); segments_by_bucket must have
+        # been laid out with the SAME value
+        self.block_rows = int(block_rows)
+        self.block = self.block_rows * LANES
 
     # -- layout ---------------------------------------------------------
     def names(self):
@@ -145,7 +150,7 @@ class UpdatePlan:
     def rows(self, bucket):
         segs = self.buckets[bucket]
         last = segs[-1]
-        return last.row0 + last.nblocks * BLOCK_ROWS
+        return last.row0 + last.nblocks * self.block_rows
 
     def grad_dtype(self, bucket):
         """The dtype gradients cross the kernel boundary in: always
@@ -185,7 +190,7 @@ class UpdatePlan:
             # the same fold the per-parameter chain's ``astype(master)``
             # gets, and the reason bf16-compute parity is bit-exact
             v = tree[seg.name].astype(dt).reshape(-1)
-            pad = seg.nblocks * BLOCK - seg.size
+            pad = seg.nblocks * self.block - seg.size
             if pad:
                 v = jnp.concatenate([v, jnp.zeros((pad,), dt)])
             parts.append(v)
@@ -246,10 +251,10 @@ class UpdatePlan:
         side; cached across steps by the step's hyper cache)."""
         lrb, wdb = {}, {}
         for bk, segs in self.buckets.items():
-            lr = np.empty(self.rows(bk) // BLOCK_ROWS, np.float32)
+            lr = np.empty(self.rows(bk) // self.block_rows, np.float32)
             wd = np.empty_like(lr)
             for seg in segs:
-                b0 = seg.row0 // BLOCK_ROWS
+                b0 = seg.row0 // self.block_rows
                 lr[b0:b0 + seg.nblocks] = lrs[seg.name]
                 wd[b0:b0 + seg.nblocks] = wds[seg.name]
             lrb[bk], wdb[bk] = lr, wd
@@ -272,7 +277,8 @@ class UpdatePlan:
                 self.kind, self.nslots, has_wc,
                 w_slabs[bk], g_slabs[bk], slot_slabs[bk],
                 wc_slabs.get(bk) if has_wc else None, self.cdtype,
-                lrb[bk], wdb[bk], hyp, self.interpret)
+                lrb[bk], wdb[bk], hyp, self.interpret,
+                block_rows=self.block_rows)
             new_w[bk] = outs[0]
             new_slots[bk] = tuple(outs[1:1 + self.nslots])
             if has_wc:
@@ -297,12 +303,16 @@ def plan_for(optimizer, params, grad_names, compute_dtype, mesh=None,
             return None
     # one layout rule: the pricing path (_segments_for) and the live
     # plan share it, so the priced slabs are the kernel's slabs
-    segs = _segments_for({n: params[n] for n in grad_names})
+    total = sum(int(np.prod(params[n].shape)) or 1 for n in grad_names)
+    br = _tuned_block_rows(total)
+    segs = _segments_for({n: params[n] for n in grad_names},
+                         block_rows=br)
     cdtype = None
     if compute_dtype is not None and \
             jnp.dtype(compute_dtype) != jnp.float32:
         cdtype = jnp.dtype(compute_dtype)
-    return UpdatePlan(kind[0], kind[1], segs, cdtype, interpret)
+    return UpdatePlan(kind[0], kind[1], segs, cdtype, interpret,
+                      block_rows=br)
 
 
 # ---------------------------------------------------------------------------
@@ -361,16 +371,16 @@ def _kernel(lrb_ref, wdb_ref, hyp_ref, w_ref, g_ref, *refs, kind, nslots,
 
 
 def _bucket_call(kind, nslots, has_wc, w, g, slots, wc, cdtype, lrb, wdb,
-                 hyp, interpret):
+                 hyp, interpret, block_rows=BLOCK_ROWS):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     rows = w.shape[0]
-    nb = rows // BLOCK_ROWS
+    nb = rows // block_rows
     blk = lambda *_: (_[0], 0)          # block i of every slab operand
-    bspec = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), blk)
+    bspec = lambda: pl.BlockSpec((block_rows, LANES), blk)
 
     in_specs = [bspec(), bspec()] + [bspec()] * nslots
     args = [w, g] + list(slots)
@@ -517,7 +527,7 @@ def priced_update_cost(param_specs, kind, nslots, compute_dtype,
     slots_s = {bk: tuple(
         jax.ShapeDtypeStruct((plan.rows(bk), LANES), jnp.dtype(bk))
         for _ in range(nslots)) for bk in plan.buckets}
-    lrb_s = {bk: jax.ShapeDtypeStruct((plan.rows(bk) // BLOCK_ROWS,),
+    lrb_s = {bk: jax.ShapeDtypeStruct((plan.rows(bk) // plan.block_rows,),
                                       jnp.float32) for bk in plan.buckets}
     hyp_s = jax.ShapeDtypeStruct((5,), jnp.float32)
     # no wc input operand: the real kernel's old compute slab is an
@@ -533,10 +543,11 @@ def priced_update_cost(param_specs, kind, nslots, compute_dtype,
             "phases": {k: int(v) for k, v in phases.items()}}
 
 
-def _segments_for(sds):
+def _segments_for(sds, block_rows=BLOCK_ROWS):
     segs = {}
     import jax.numpy as jnp
 
+    block = block_rows * LANES
     buckets = {}
     for name, v in sds.items():
         buckets.setdefault(jnp.dtype(v.dtype).name, []).append(
@@ -546,9 +557,9 @@ def _segments_for(sds):
         out = []
         for name, shape in entries:
             size = int(np.prod(shape)) if shape else 1
-            nblocks = max(1, -(-size // BLOCK))
+            nblocks = max(1, -(-size // block))
             out.append(_Segment(name, shape, size, row, nblocks))
-            row += nblocks * BLOCK_ROWS
+            row += nblocks * block_rows
         segs[bk] = out
     return segs
 
@@ -564,3 +575,71 @@ def priced_update_cost_for_step(step):
     specs = {n: params[n] for n in step._grad_names}
     return priced_update_cost(specs, kind[0], kind[1],
                               step._cdtype, interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# tunable space (ops/tuning.py): grid-block height per param-count class
+# ---------------------------------------------------------------------------
+
+def _tuned_block_rows(total):
+    """The tuning cache's grid-block height for a trainable population of
+    ``total`` elements (:data:`BLOCK_ROWS` when cold and no sweep armed),
+    clamped to the bf16 minimum sublane tile."""
+    from . import tuning
+
+    br = int(tuning.resolve("pallas_update",
+                            tuning.shape_class_for(n=max(int(total), 1)),
+                            "any").get("block_rows", BLOCK_ROWS))
+    return max(16, (br // 16) * 16)
+
+
+def _tuning_candidates(shape_class, interpret):
+    if interpret:
+        # 2-candidate toy space for the tier-1 CPU sweep
+        return [{"block_rows": 16}, {"block_rows": 32}]
+    return [{"block_rows": br} for br in (16, 32, 64, 128)]
+
+
+def _tuning_runner(params, shape_class, dtype, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    from . import tuning
+
+    n = tuning.parse_shape_class(shape_class).get("n", 1 << 16)
+    br = params["block_rows"]
+    if br <= 0 or br % 16:
+        raise tuning.SpaceError("block_rows %r not a multiple of the "
+                                "bf16 sublane tile" % (br,))
+    block = br * LANES
+    nb = max(1, -(-n // block))
+    rows = nb * br
+    w = jnp.zeros((rows, LANES), jnp.float32)
+    g = jnp.ones((rows, LANES), jnp.float32)
+    m = jnp.zeros((rows, LANES), jnp.float32)
+    lrb = np.full((nb,), 0.1, np.float32)
+    wdb = np.zeros((nb,), np.float32)
+    hyp = np.array([1.0, 0.0, 0.9], np.float32)
+
+    @jax.jit
+    def probe(w, g, m):
+        return _bucket_call("sgd", 1, False, w, g, (m,), None, None,
+                            lrb, wdb, hyp, interpret, block_rows=br)
+
+    def run():
+        jax.block_until_ready(probe(w, g, m))
+
+    return run
+
+
+def _register_space():
+    from . import tuning
+
+    tuning.register_space(
+        "pallas_update", version=1,
+        defaults={"block_rows": BLOCK_ROWS},
+        constants=("BLOCK_ROWS", "BLOCK"),
+        candidates=_tuning_candidates, runner=_tuning_runner)
+
+
+_register_space()

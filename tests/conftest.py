@@ -23,6 +23,12 @@ except AttributeError:
             flags + " --xla_force_host_platform_device_count=8").strip()
 jax.config.update("jax_enable_x64", True)
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 "
+        "(-m 'not slow')")
+
+
 # Do NOT arm jax's persistent compilation cache here: on this
 # jaxlib (0.4.36, XLA:CPU) a cache-DESERIALIZED executable can return
 # different floating-point results than a fresh compile of the same

@@ -500,12 +500,13 @@ def test_mxlint_smoke_contract():
     ckpt_train_step by a real fit under async fenced checkpointing;
     moe_train_step by a real top-2 capacity-routed MoE LM step whose
     explicit all-to-all dispatch the collective pass budgets) with
-    all six passes and report ZERO unsuppressed findings — the
+    all seven passes and report ZERO unsuppressed findings — the
     static-analysis acceptance line: donation aliasing, collective
-    budgets, retrace counts, host-sync lint, FLOP/dtype coverage and
-    cache-byte budgets (pool bytes for the paged programs) all green
-    against benchmarks/budgets.json on the 8-virtual-device CPU
-    platform."""
+    budgets, retrace counts, host-sync lint, FLOP/dtype coverage,
+    cache-byte budgets (pool bytes for the paged programs) and the
+    tuner-coverage audit (every Pallas block constant registered with
+    ops/tuning) all green against benchmarks/budgets.json on the
+    8-virtual-device CPU platform."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     # scrub analysis knobs: the smoke must measure the committed budget
@@ -528,14 +529,14 @@ def test_mxlint_smoke_contract():
     assert head["errors"] == 0 and head["warnings"] == 0, head
     # every canonical program was built (the virtual mesh gives ring×TP
     # and the expert-parallel MoE step)
-    assert head["programs"] == 12 and head["passes"] == 6, head
+    assert head["programs"] == 12 and head["passes"] == 7, head
     assert head["skipped_programs"] == [], head
 
     # stderr: one JSON finding per line; every (pass, program) pair ran
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     pairs = {(r["pass"], r["program"]) for r in rows if "pass" in r}
-    assert len(pairs) == 72, sorted(pairs)
+    assert len(pairs) == 84, sorted(pairs)
     # the expert-parallel step's committed all-to-all ceiling is live:
     # the collective pass measured real exchanges within budget
     a2a_row = next(r for r in rows

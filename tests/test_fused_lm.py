@@ -1,0 +1,131 @@
+"""The fused LN->linear epilogues wired into the attention-LM train step
+(ISSUE-16 tentpole piece 1).
+
+What tier-1 pins:
+
+* knob parity END TO END: a module traced with ``MXNET_PALLAS_FUSED``
+  (interpret-mode kernels on CPU) produces the same loss and the same
+  gradients as an identically-parameterized module traced on the stock
+  einsum path — asserted on the forward output and on the params after
+  one SGD step (param delta = -lr * grad, so one step pins the whole
+  backward) — with the ``FUSED_PATH`` tripwire proving each module
+  really took its path.  Fresh modules per knob state are load-bearing:
+  the executor's per-op program cache is knob-OPAQUE, so a same-module
+  flip would silently re-run the old trace;
+* the ``lm_fused`` roofline pricing: an armed step's FusedLNLinear
+  segments price strictly fewer HBM bytes than the einsum chain they
+  replace, and the row lands in ``obs.mfu_table`` under the step's
+  telemetry name.
+
+Tolerance note: the attention ``*_k_bias`` gradient is ANALYTICALLY
+zero (softmax is shift-invariant, so a constant bias added to every
+key cancels) — its values are fp cancellation noise on both paths, so
+comparisons use an absolute floor rather than pure relative error.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import obs
+from mxnet_tpu.io import DataBatch, DataDesc
+from mxnet_tpu.models import attention_lm
+from mxnet_tpu.ops.fused_lm import (FUSED_PATH, priced_fused_cost_for_step,
+                                    step_has_fused_segments)
+
+# m = B*T must clear pallas_fused.supported's m % 256 gate or the armed
+# module is einsum-gated and the parity test proves nothing
+B, T, VOCAB, EMBED, HEADS, FFN = 2, 128, 32, 64, 2, 128
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, VOCAB, size=(B, T)).astype(np.float32)
+    y = np.concatenate([x[:, 1:], np.zeros((B, 1), np.float32)], axis=1)
+    dd = DataDesc("data", (B, T), layout="NT")
+    ld = DataDesc("softmax_label", (B, T), layout="NT")
+    return DataBatch([nd.array(x)], [nd.array(y)], provide_data=[dd],
+                     provide_label=[ld]), dd, ld
+
+
+def _fresh_module(dd, ld):
+    net = attention_lm.get_symbol(vocab_size=VOCAB, seq_len=T,
+                                  num_layers=1, embed=EMBED, heads=HEADS,
+                                  ffn_hidden=FFN)
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype="float32")
+    mod.bind(data_shapes=[dd], label_shapes=[ld])
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    return mod
+
+
+def _assert_close(a0, a1, key, tol=1e-3):
+    # absolute floor: analytically-zero grads (k_bias) are pure noise
+    s = max(float(np.max(np.abs(a0))), 1e-4)
+    err = float(np.max(np.abs(np.asarray(a0) - np.asarray(a1)))) / s
+    assert err < tol, (key, err)
+
+
+def test_fused_knob_parity_tripwire_and_priced_roofline_row():
+    batch, dd, ld = _batch()
+
+    def run(fused, params=None, name=None):
+        with config.overrides(MXNET_PALLAS_FUSED=fused,
+                              MXNET_PALLAS_INTERPRET=fused):
+            mod = _fresh_module(dd, ld)
+            if params is not None:
+                mod.set_params({k: nd.array(v) for k, v in params.items()},
+                               {})
+            # snapshot to NUMPY: get_params can return live views that
+            # the coming update mutates in place
+            init = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+            step = mod._fused_step
+            if name is not None:
+                # rename BEFORE the first run so the roofline row
+                # registers under a name no other test collides with
+                step.telemetry_name = name
+            FUSED_PATH["last"] = None
+            mod.forward_backward(batch)
+            mod.update()
+            out = mod.get_outputs()[0].asnumpy()
+            path = FUSED_PATH["last"]
+            trained = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+        return out, trained, path, init, step
+
+    out0, p0, path0, init, _ = run(False)
+    out1, p1, path1, _, step = run(True, params=init, name="tflm_roofline")
+
+    # the tripwire: each module really took its path
+    assert path0 == "einsum"
+    assert path1 == "pallas"
+
+    # forward parity + post-step param parity (delta = -lr * grad, so
+    # one SGD step pins the whole backward through every segment)
+    _assert_close(out0, out1, "output")
+    assert set(p0) == set(p1) and p0
+    for key in sorted(p0):
+        _assert_close(p0[key], p1[key], key)
+
+    # the armed step's priced lm_fused row: strictly fewer bytes than
+    # the einsum chain it replaces — the acceptance inequality of the
+    # 0.15-MFU plateau issue
+    assert step_has_fused_segments(step)
+    with config.overrides(MXNET_PALLAS_FUSED=True,
+                          MXNET_PALLAS_INTERPRET=True):
+        priced = priced_fused_cost_for_step(step)
+        assert priced["fused_path"] == "pallas"
+        assert 0 < priced["fused_kernel_bytes"] < priced["fused_einsum_bytes"]
+        assert priced["segments"] == 5   # q, k, v, ffn1, ffn2 per layer
+
+        rows = [r for r in obs.mfu_table(1e12)
+                if r["program"] == "tflm_roofline:lm_fused"]
+        assert rows, [r["program"] for r in obs.mfu_table(1e12)]
+        assert rows[0]["fused_path"] == "pallas"
+        assert rows[0]["fused_kernel_bytes"] < rows[0]["fused_einsum_bytes"]
+
+    # the same step priced OUTSIDE the knob reads einsum: fused_path is
+    # the LIVE dispatch, so an unarmed process sees the fallback pricing
+    priced = priced_fused_cost_for_step(step)
+    assert priced["fused_path"] == "einsum"

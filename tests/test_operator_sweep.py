@@ -743,6 +743,7 @@ TESTED_ELSEWHERE = {
     "dot_product_attention": "test_seq_parallel.py",
     "_contrib_DotProductAttention": "test_seq_parallel.py",
     "MoEFFN": "test_moe.py", "_contrib_MoEFFN": "test_moe.py",
+    "FusedLNLinear": "test_fused_lm.py",
     "count_sketch": "test_spatial_contrib.py",
     "_contrib_count_sketch": "test_spatial_contrib.py",
     "_slice_assign": "test_reference_parity.py",

@@ -300,3 +300,41 @@ def test_op_path_selection_is_flash_and_trains(pallas_interpret_flag):
     ex3.forward(is_train=False)
     ex3.outputs[0].asnumpy()
     assert PATH_TAKEN["last"] == "einsum"
+
+
+def test_odd_t_pick_block_degenerates_to_einsum_fallback():
+    """Odd/prime T: ``_pick_block`` refuses both degenerate shapes — the
+    below-MIN_BLOCK walk (T=7) and the tile-misaligned full-T block a
+    prime T <= pref used to come back as (T=127) — and
+    ``flash_attention`` takes the differentiable einsum fallback, whose
+    fwd AND grads match the plain reference."""
+    import jax
+    import jax.numpy as jnp
+
+    t = 127
+    for bad_t in (7, t):
+        assert pa._pick_block(pa.BLOCK_Q, bad_t) == 0, bad_t
+        assert pa._pick_block(pa.BLOCK_K, bad_t) == 0, bad_t
+
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng, 2, t, 64)
+    scale = 1.0 / np.sqrt(64)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(pa.flash_attention(q, k, v, scale=scale,
+                                          causal=True, interpret=True))
+
+    def ref_loss(q, k, v):
+        return jnp.sum(sdpa(q, k, v, num_heads=1, causal=True))
+
+    args = tuple(jnp.asarray(a) for a in (q, k, v))
+    out = np.asarray(pa.flash_attention(*args, scale=scale, causal=True,
+                                        interpret=True))
+    ref = np.asarray(sdpa(*args, num_heads=1, causal=True))
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+    g = jax.grad(flash_loss, argnums=(0, 1, 2))(*args)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(*args)
+    for a, b in zip(g, g_ref):
+        assert_almost_equal(np.asarray(a), np.asarray(b),
+                            rtol=1e-4, atol=1e-5)
