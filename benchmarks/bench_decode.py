@@ -46,6 +46,23 @@ attention-LM generating tokens through ``mxnet_tpu.decode`` —
   ``pallas_decode_enabled``; non-smoke asserts the fused path prices
   <= 0.5x the einsum path's bytes at T=2048 — the mfu_table traffic win.
 
+* **gqa** — grouped-query attention (``num_kv_heads = heads/G``,
+  docs/inference.md): for each group factor G in the grid the bench
+  builds a grouped LM, re-drains the SAME shared-prefix paged trace and
+  statically prices the decode step's attention traffic.  Every K/V
+  plane — page pools, int8 scale planes, ring caches — is physically
+  G x narrower, so the pool shrink is asserted as EXACT arithmetic
+  (``gqa_pool_bytes * G == mha_pool_bytes``), the G=1 row IS the MHA
+  paged serve (same symbol object, same predictor config — the grouped
+  path is bit-exact when there is nothing to group, pinned across
+  dense/ring/flash/decode in tests/test_gqa.py), and retrace counts
+  stay at the paged phase's zero-retrace bar.  Published: ``gqa_cache_bytes_per_slot``,
+  ``gqa_decode_attn_bytes_per_token``, ``vs_mha_tokens_per_sec_per_gb``
+  and the int8 x G compounding ratio against the f32 MHA pool;
+  non-smoke asserts at the top grid G (>= 4 at T=2048): pool
+  <= 0.3x MHA, priced attention bytes <= 0.35x MHA, int8-grouped pool
+  <= 0.1x the f32 MHA pool.
+
 The bench also ASSERTS the O(1)-in-prefix property statically: dot FLOPs
 (``parallel.hlo_stats.dot_flops``) of the lowered decode-step program must
 not grow with the prefix, while the full-forward program's roughly double
@@ -66,7 +83,8 @@ Env knobs: BENCH_T, BENCH_BATCH, BENCH_EMBED, BENCH_HEADS, BENCH_VOCAB,
 BENCH_LAYERS, BENCH_DECODE_STEPS, BENCH_NAIVE_STEPS, BENCH_DTYPE,
 BENCH_SPEC_K (draft width, default 8), BENCH_KV_DTYPE (default int8),
 BENCH_SERVE_REQS, BENCH_MAX_NEW, BENCH_SHARED_REQS, BENCH_PAGE_TOKENS,
-BENCH_PREFILL_CHUNK.
+BENCH_PREFILL_CHUNK, BENCH_GQA_GROUPS (comma list of group factors G;
+default "1,4,8" filtered to divisors of BENCH_HEADS).
 ``--smoke``: the tier-1 CI entry — tiny dims on the forced-CPU platform
 (tests/test_bench_contract.py invokes it).
 """
@@ -392,13 +410,13 @@ def main():
     from mxnet_tpu.analysis.cost import program_cost
     from mxnet_tpu.ops.attention import decode_kernel_mode
 
-    def _price_decode_attn(arm):
+    def _price_decode_attn(arm, psym=sym, pparams=params):
         knobs = {"MXNET_PALLAS_DECODE": "1" if arm else "0"}
         if arm and not on_tpu:
             knobs["MXNET_PALLAS_INTERPRET"] = "1"
         with _cfg.overrides(**knobs):
             pp2 = DecodePredictor(
-                sym, params, cache_len=paged_cap, temperature=0.0,
+                psym, pparams, cache_len=paged_cap, temperature=0.0,
                 kv_dtype=kv_dtype, paged=True, page_tokens=page_tokens,
                 pool_pages=pool_pages)
             st = pp2.paged_batch_state(slots)
@@ -437,6 +455,114 @@ def main():
             "fused decode attention prices %d bytes vs einsum %d " \
             "(acceptance: <= 0.5x at T=%d)" % (attn_fused, attn_einsum, t)
 
+    # ---- GQA/MQA head groups: the KV bill divided by G -----------------
+    # grouped-query attention keeps every q head but shares each K/V head
+    # across a group of G queries (num_kv_heads = heads/G), so every K/V
+    # plane — page pools, int8 scale planes, swap wires — is physically
+    # G x narrower.  Same shared-prefix trace, same spec x quant settings
+    # as serve_paged: the delta IS the head grouping.
+    gqa_env = os.environ.get("BENCH_GQA_GROUPS")
+    wanted = tuple(int(x) for x in gqa_env.split(",")) if gqa_env \
+        else (1, heads) if SMOKE else (1, 4, 8)
+    gqa_grid = sorted({g for g in wanted if g >= 1 and heads % g == 0})
+    dropped = sorted(set(wanted) - set(gqa_grid))
+    if dropped:
+        # no silent caps: name the grid points divisibility dropped
+        emit({"phase": "gqa", "note": "groups %s dropped: BENCH_HEADS=%d "
+              "not divisible" % (dropped, heads)})
+    assert gqa_grid and gqa_grid[-1] > 1, \
+        "GQA grid %r has no grouped member for heads=%d" % (gqa_grid, heads)
+
+    # the f32 MHA pool: the ungrouped, unquantized baseline the
+    # int8 x G compounding ratio divides by
+    fpred = DecodePredictor(sym, params, cache_len=paged_cap,
+                            temperature=0.0, kv_dtype="", paged=True,
+                            page_tokens=page_tokens, pool_pages=pool_pages)
+    fpred.paged_batch_state(slots)
+    mha_pool_f32 = fpred.pool_bytes()
+    mha_pool = ppred.pool_bytes()  # the int8 pool the serve above drained
+
+    gqa_rows = {}
+    for g in gqa_grid:
+        kvh = heads // g
+        if g == 1:
+            # G=1 builds the SAME symbol object with ppred's exact
+            # predictor config (paged/quant/spec settings verbatim), so
+            # the row reuses the measured paged serve and its pricing —
+            # re-serving an identical fresh predictor would only re-pay
+            # its program traces.  The nontrivial G=1 bit-parity claims
+            # (grouped graph json == ungrouped, dense/ring/flash/decode
+            # identity) live in tests/test_gqa.py.
+            gpred, server_g = ppred, server_p
+            gqa_tok_s, attn_g = paged_tok_s, attn_active
+        else:
+            gsym = attention_lm.get_symbol(
+                vocab_size=vocab, seq_len=t, num_layers=layers, embed=e,
+                heads=heads, ffn_hidden=4 * e, num_kv_heads=kvh)
+            grng = np.random.RandomState(0)
+            # NB: the token-identity loops above rebound ``b`` — size
+            # the probe from the prompt batch, not the loop leftover
+            gbatch = int(prompts.shape[0])
+            gshapes, _, gaux = gsym.infer_shape(
+                data=(gbatch, t), softmax_label=(gbatch, t))
+            gparams = {}
+            for name, shape in zip(gsym.list_arguments(), gshapes):
+                if name in ("data", "softmax_label"):
+                    continue
+                gparams[name] = grng.normal(
+                    0, 0.02, shape).astype(np.float32)
+            for name, shape in zip(gsym.list_auxiliary_states(), gaux):
+                gparams["aux:" + name] = np.zeros(shape, np.float32)
+
+            gpred = DecodePredictor(
+                gsym, gparams, cache_len=paged_cap, temperature=0.0,
+                kv_dtype=kv_dtype, paged=True, page_tokens=page_tokens,
+                pool_pages=pool_pages,
+                prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK",
+                                                 "64")))
+            server_g, gqa_tok_s, _gqa_out = run_serve(
+                gpred, workload=strace, window=hi2, spec_k=spec_k)
+            attn_g, _ = _price_decode_attn(pallas_enabled, psym=gsym,
+                                           pparams=gparams)
+        # grouping must not perturb trace stability: zero retraces
+        # across admission, COW forks and retirement, same bar as paged
+        gtc = gpred.trace_counts
+        assert gtc["chunk"] == 1 and all(
+            gtc[prog] <= 1
+            for prog in ("decode", "verify", "fork", "commit")), gtc
+
+        gqa_pool = gpred.pool_bytes()
+        # the pool shrink is exact arithmetic, not a measurement: data
+        # AND scale planes are each G x narrower
+        assert gqa_pool * g == mha_pool, (g, gqa_pool, mha_pool)
+        gqa_gb = gqa_pool / 1e9
+        row = {"groups": g, "num_kv_heads": kvh,
+               "cache_bytes_per_slot": gqa_pool // slots,
+               "pool_bytes": gqa_pool,
+               "pool_ratio_vs_mha": round(gqa_pool / mha_pool, 4),
+               "decode_attn_bytes_per_token": round(attn_g / slots, 1),
+               "attn_bytes_ratio_vs_mha": round(attn_g / attn_active, 4),
+               "tokens_per_sec": round(gqa_tok_s, 1),
+               "tokens_per_sec_per_gb": round(gqa_tok_s / gqa_gb, 1),
+               "vs_mha_tokens_per_sec_per_gb": round(
+                   (gqa_tok_s / gqa_gb) / paged_tok_s_per_gb, 3),
+               "decode_steps": server_g.steps,
+               "spec_steps": server_g.spec_steps}
+        gqa_rows[g] = row
+        emit(dict(row, phase="gqa"))
+
+    gstar = gqa_grid[-1]
+    star = gqa_rows[gstar]
+    # int8 quantization compounds with grouping — both shrink the same
+    # planes, so the product lands against the f32 MHA pool
+    int8_vs_f32_mha = star["pool_bytes"] / mha_pool_f32
+    if not SMOKE and gstar >= 4:
+        # the GQA acceptance lines at full dims (T=2048, G >= 4)
+        assert star["pool_bytes"] <= 0.3 * mha_pool, star
+        assert star["decode_attn_bytes_per_token"] <= \
+            0.35 * (attn_active / slots), (star, attn_active)
+        assert int8_vs_f32_mha <= 0.1, (star, mha_pool_f32)
+
     print(json.dumps({
         "metric": "decode_tokens_per_sec_t%d" % t,
         "value": round(decode_tok_s, 1),
@@ -467,6 +593,21 @@ def main():
         "decode_attn_bytes_per_token_einsum": round(attn_einsum / slots, 1),
         "decode_attn_bytes_per_token_fused": round(attn_fused / slots, 1),
         "decode_attn_bytes_ratio": round(attn_ratio, 3),
+        "gqa_groups": gqa_grid,
+        "gqa_group": gstar,
+        "gqa_num_kv_heads": heads // gstar,
+        "gqa_cache_bytes_per_slot": star["cache_bytes_per_slot"],
+        "gqa_pool_bytes": star["pool_bytes"],
+        "gqa_pool_ratio_vs_mha": star["pool_ratio_vs_mha"],
+        "gqa_decode_attn_bytes_per_token":
+            star["decode_attn_bytes_per_token"],
+        "gqa_attn_bytes_ratio_vs_mha": star["attn_bytes_ratio_vs_mha"],
+        "gqa_tokens_per_sec": star["tokens_per_sec"],
+        "gqa_tokens_per_sec_per_gb": star["tokens_per_sec_per_gb"],
+        "vs_mha_tokens_per_sec_per_gb":
+            star["vs_mha_tokens_per_sec_per_gb"],
+        "gqa_int8_vs_f32_mha_pool_ratio": round(int8_vs_f32_mha, 4),
+        "mha_pool_bytes_f32": mha_pool_f32,
     }))
 
 

@@ -462,6 +462,28 @@ class CacheBytesPass(Pass):
                     "every decode step streams full-precision bytes"
                     % (kv_dtype, wide),
                     code="f32-cache", kv_dtype=kv_dtype, wide=wide))
+        # grouped-K/V promise (meta['num_kv_heads'] from a GQA config):
+        # every cache/pool plane must be H_kv head slices wide — an H_q-
+        # wide allocation means the num_kv_heads plumbing was dropped and
+        # the G× pool shrink silently forfeited
+        if artifact.meta.get("num_kv_heads"):
+            widths = artifact.meta.get("cache_kv_dims") or []
+            for dims in artifact.meta.get("attn_dims") or []:
+                q_dim = dims.get("q_dim")
+                kv_dim = dims.get("kv_dim")
+                if dims.get("num_kv_heads") == dims.get("num_heads") \
+                        or q_dim == kv_dim or kv_dim is None:
+                    continue
+                if q_dim in widths:
+                    findings.append(self.finding(
+                        artifact, "error",
+                        "config promises grouped K/V (num_kv_heads=%s < "
+                        "num_heads=%s) but a cache/pool plane allocates "
+                        "the full q width %d (expected %d) — the grouped "
+                        "layout was dropped and the pool is G× too large"
+                        % (dims.get("num_kv_heads"),
+                           dims.get("num_heads"), q_dim, kv_dim),
+                        code="mha-under-gqa", q_dim=q_dim, kv_dim=kv_dim))
         budget = context.budget_for(artifact.name) or {}
         ceiling = budget.get("cache_bytes")
         if ceiling is None:

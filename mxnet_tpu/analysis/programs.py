@@ -1,7 +1,7 @@
 """Builders for the canonical programs the lint audits.
 
-``tools/mxlint.py`` (and the tier-1 smoke) checks twelve programs — the
-compiled surfaces behind every headline number so far:
+``tools/mxlint.py`` (and the tier-1 smoke) checks thirteen programs —
+the compiled surfaces behind every headline number so far:
 
 * ``train_step``  — the fused forward+backward+optimizer program
   (bfloat16 compute, donated params/slots/aux);
@@ -26,6 +26,10 @@ compiled surfaces behind every headline number so far:
   kernel lowered instead of the three-pass einsum fallback; their
   cache-bytes meta is the POOL total (the paged serving HBM bill the
   cache-bytes pass budgets);
+* ``gqa_decode_step`` — the paged decode program under a grouped-query
+  layout (num_kv_heads < num_heads): pools allocate H_kv head slices,
+  and the cache-bytes pass's ``mha-under-gqa`` tripwire proves the G×
+  pool shrink actually happened;
 * ``ring_tp_step`` — the attention-LM fused step on the composed
   (data, seq, model) mesh: ring attention with head groups sharded on
   'model' (needs >= 4 devices; the smoke forces the 8-virtual-device
@@ -327,6 +331,56 @@ def _paged_artifacts():
                                      name="paged_verify_step"))
 
 
+def _gqa_artifacts():
+    """gqa_decode_step: the paged decode program under a GROUPED-QUERY
+    layout (num_kv_heads < num_heads), driven by a real grouped paged
+    serve with the fused kernel armed.
+
+    The grouped config (G = heads/kv_heads = 4 here) allocates pools
+    H_kv heads wide — the cache-bytes meta carries the grouped promise
+    (``num_kv_heads``/``attn_dims``/``cache_kv_dims``), so the
+    cache-bytes pass's ``mha-under-gqa`` tripwire proves the pool really
+    shrank by G and a dropped num_kv_heads is a red lint run."""
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.decode import DecodePredictor, DecodeServer
+    from mxnet_tpu.models import attention_lm
+
+    import jax
+
+    knobs = {"MXNET_PALLAS_DECODE": "1"}
+    if jax.default_backend() != "tpu":
+        knobs["MXNET_PALLAS_INTERPRET"] = "1"
+    with _config.overrides(**knobs):
+        d = _LM
+        rng = np.random.RandomState(5)
+        sym = attention_lm.get_symbol(
+            vocab_size=d["vocab"], seq_len=d["seq_len"],
+            num_layers=d["layers"], embed=d["embed"], heads=d["heads"],
+            ffn_hidden=d["ffn"], num_kv_heads=1)
+        pred = DecodePredictor(
+            sym, _lm_params(sym, d["batch"], d["seq_len"]),
+            cache_len=d["seq_len"], temperature=0.0, kv_dtype="",
+            paged=True, page_tokens=4, prefill_chunk=4)
+        server = DecodeServer(pred, max_prefill=12, slots=d["batch"],
+                              max_new_tokens=3)
+        prefix = rng.randint(0, d["vocab"], size=(6,))
+        for n in (3, 5, 2, 4):          # shared prefix, mixed tails
+            server.submit(np.concatenate(
+                [prefix, rng.randint(0, d["vocab"], size=(n,))]))
+        results = server.run()
+        if len(results) != 4:
+            raise MXNetError(
+                "grouped paged serve drive did not complete "
+                "(results=%d)" % (len(results),))
+        state = pred.paged_batch_state(d["batch"])
+        art = pred.decode_artifact(state, name="gqa_decode_step")
+        if not art.meta.get("num_kv_heads"):
+            raise MXNetError(
+                "gqa_decode_step artifact carries no grouped-K/V meta; "
+                "the mha-under-gqa tripwire would be vacuous")
+        return (art,)
+
+
 def _ckpt_train_step_artifact():
     """The fused step of a real ``fit()`` under async fenced
     checkpointing.
@@ -517,6 +571,11 @@ def _moe_builder(want):
     return [("moe_train_step", _moe_train_step_artifact())]
 
 
+def _gqa_builder(want):
+    (art,) = _gqa_artifacts()
+    return [("gqa_decode_step", art)]
+
+
 def _ckpt_builder(want):
     return [("ckpt_train_step", _ckpt_train_step_artifact())]
 
@@ -532,6 +591,7 @@ if "train_step" not in _registry.canonical_names():
         _speculative_builder)
     _registry.register_canonical(
         ("paged_decode_step", "paged_verify_step"), _paged_builder)
+    _registry.register_canonical(("gqa_decode_step",), _gqa_builder)
     _registry.register_canonical(("ring_tp_step",), _ring_builder,
                                  availability=_ring_available)
     _registry.register_canonical(("moe_train_step",), _moe_builder,
@@ -544,7 +604,7 @@ CANONICAL_PROGRAMS = _registry.canonical_names()
 
 
 def build_canonical_artifacts(names=None):
-    """Build the requested canonical artifacts (default: all twelve) —
+    """Build the requested canonical artifacts (default: all thirteen) —
     a registry enumeration now (``programs.registry.build_canonical``).
 
     Returns ``(artifacts, notes)`` — ``notes`` maps a program that could

@@ -243,6 +243,19 @@ class DecodePredictor:
         if not self._attn_nodes:
             raise MXNetError("symbol has no dot_product_attention node; "
                              "nothing to cache — use Predictor")
+        # per-attention-node head dims, recorded at trace time by _run
+        # (num_heads / num_kv_heads / q_dim / kv_dim) — the grouped-layout
+        # source of truth for cache meta and CacheBytesPass
+        self._attn_dims = []
+        # grouped-query config (any node with num_kv_heads < num_heads):
+        # the kv-head count gating the cache/pool trailing-dim shard
+        grouped = []
+        for n in self._attn_nodes:
+            a = n.parsed_attrs()
+            kvh = a.get("num_kv_heads", 0) or a.get("num_heads", 1)
+            if kvh != a.get("num_heads", 1):
+                grouped.append(int(kvh))
+        self._grouped_kv_heads = min(grouped) if grouped else None
 
         self._cache_sharding = None
         self._partition_rules = None
@@ -271,7 +284,8 @@ class DecodePredictor:
             self._env.update({n: jax.device_put(a.data, rep)
                               for n, a in aux_params.items()})
             self._cache_sharding = NamedSharding(
-                mesh, kv_cache_pspec(mesh.shape))
+                mesh, kv_cache_pspec(
+                    mesh.shape, num_kv_heads=self._grouped_kv_heads))
             self._token_sharding = NamedSharding(
                 mesh, P("data" if sizes.get("data", 1) > 1 else None, None))
         else:
@@ -441,38 +455,55 @@ class DecodePredictor:
             if opname == "dot_product_attention":
                 q, k, v = ins
                 heads = attrs.get("num_heads", 1)
+                # grouped-query attention: the K/V stream (and so the
+                # cache/pool) is physically kv_heads wide — every append/
+                # quantize below works in kv-head units, attends map
+                # q-head h to kv group h // G
+                kv_heads = attrs.get("num_kv_heads", 0) or heads
+                ai = ci
+                ci += 1
+                dims = dict(num_heads=int(heads),
+                            num_kv_heads=int(kv_heads),
+                            q_dim=int(q.shape[-1]),
+                            kv_dim=int(k.shape[-1]))
+                if ai < len(self._attn_dims):
+                    self._attn_dims[ai] = dims
+                else:
+                    self._attn_dims.append(dims)
                 scale = attrs.get("scale", 0.0) or None
                 if caches is None:
                     outs = [_attn.sdpa(q, k, v, num_heads=heads,
                                        causal=attrs.get("causal", False),
-                                       scale=scale)]
-                    new_caches.append((self._fill_cache(k, heads),
-                                       self._fill_cache(v, heads)))
+                                       scale=scale,
+                                       num_kv_heads=kv_heads)]
+                    new_caches.append((self._fill_cache(k, kv_heads),
+                                       self._fill_cache(v, kv_heads)))
                 else:
-                    kc, vc = caches[ci]
-                    ci += 1
+                    kc, vc = caches[ai]
                     pos = jnp.asarray(pos0, jnp.int32).reshape(-1)
                     mesh_on = self._mesh is not None
                     if tables is not None:
                         kc = _attn.paged_append(kc, tables, k, pos0,
-                                                num_heads=heads,
+                                                num_heads=kv_heads,
                                                 active=active, valid=valid)
                         vc = _attn.paged_append(vc, tables, v, pos0,
-                                                num_heads=heads,
+                                                num_heads=kv_heads,
                                                 active=active, valid=valid)
                         outs = [_attn.paged_attend(q, kc, vc, tables,
                                                    pos + t, num_heads=heads,
                                                    scale=scale,
-                                                   mesh_active=mesh_on)]
+                                                   mesh_active=mesh_on,
+                                                   num_kv_heads=kv_heads)]
                     else:
                         kc = _attn.cache_append(kc, k, pos0,
-                                                num_heads=heads)
+                                                num_heads=kv_heads)
                         vc = _attn.cache_append(vc, v, pos0,
-                                                num_heads=heads)
+                                                num_heads=kv_heads)
                         outs = [_attn.cache_attend(q, kc, vc, pos + t,
                                                    num_heads=heads,
                                                    scale=scale,
-                                                   mesh_active=mesh_on)]
+                                                   mesh_active=mesh_on,
+                                                   num_kv_heads=kv_heads)]
                     # PATH_TAKEN, recorded at trace time: which decode-
                     # attention path this predictor's programs actually
                     # lowered — refines artifact meta so a shape-gated
@@ -823,7 +854,8 @@ class DecodePredictor:
 
         from .parallel.tp_rules import kv_pool_pspec
 
-        spec = kv_pool_pspec(self._mesh.shape)
+        spec = kv_pool_pspec(self._mesh.shape,
+                             num_kv_heads=self._grouped_kv_heads)
         if spec[2] is not None and \
                 buf.shape[2] % dict(self._mesh.shape)[spec[2]] != 0:
             spec = P(None, None, None)
@@ -1587,6 +1619,19 @@ class DecodePredictor:
                 # on TPU), so only silent fallbacks trip the error
                 "pallas_decode": bool(decode_kernel_mode()[0]
                                       and self._mesh is None)}
+        if self._grouped_kv_heads is not None:
+            # grouped-K/V promise + the widths actually allocated: the
+            # cache-bytes pass errors when a cache/pool plane comes out
+            # H_q heads wide under this promise (a dropped num_kv_heads
+            # silently forfeits the G× pool shrink)
+            meta["num_kv_heads"] = int(self._grouped_kv_heads)
+            meta["attn_dims"] = [dict(d) for d in self._attn_dims]
+            widths = set()
+            for kc, vc in state.caches:
+                for c in (kc, vc):
+                    widths.add(int((c.data if isinstance(c, QuantKV)
+                                    else c).shape[2]))
+            meta["cache_kv_dims"] = sorted(widths)
         if self._paged:
             meta["page_tokens"] = self._page_tokens
             if self._manager is not None:
@@ -2487,6 +2532,14 @@ class DecodeServer:
         pred = self._pred
         mgr = pred._manager
         rec = entry["swap"]
+        if getattr(rec, "kv_heads", None) != pred._grouped_kv_heads:
+            # page planes are raw pool bytes with no head structure of
+            # their own — installing a grouped record into an MHA host
+            # (or across different G) would silently misread every page
+            raise MXNetError(
+                "swap restore: record kv layout (kv_heads=%r) does not "
+                "match this host's (kv_heads=%r)"
+                % (rec.kv_heads, pred._grouped_kv_heads))
         m = mgr.pages_per_slot
         remaining = max(rec.cap - len(rec.delivered), 0)
         total = rec.lens + remaining + self._spec_k + 1
@@ -2577,7 +2630,7 @@ class DecodeServer:
             int(np.asarray(ps["state"].tok)[slot, 0]),
             valid, data, kind="swap",
             submit_ts=req.get("submit"), first_ts=req.get("first"),
-            rid=rec["rid"])
+            rid=rec["rid"], kv_heads=pred._grouped_kv_heads)
         mgr.free_slot(slot)
         ps["act_mask"][slot] = 0
         ps["slot_lens"][slot] = 0

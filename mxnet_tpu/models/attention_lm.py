@@ -42,7 +42,7 @@ def layer_norm(data, embed, name):
 
 
 def block(data, embed, heads, ffn_hidden, name, moe_experts=0,
-          moe_capacity_factor=0.0, moe_top_k=1):
+          moe_capacity_factor=0.0, moe_top_k=1, num_kv_heads=0):
     """One pre-norm decoder block.
 
     The LN->linear segments run through :class:`FusedLNLinear` (the LN
@@ -50,16 +50,33 @@ def block(data, embed, heads, ffn_hidden, name, moe_experts=0,
     the op dispatches to the fused Pallas epilogue kernel forward and
     backward, otherwise it traces the same five-op einsum composition
     this graph always ran.  Parameter names/shapes are unchanged either
-    way (``*_ln_gamma``/``*_ln_beta``, FC-layout weight/bias)."""
+    way (``*_ln_gamma``/``*_ln_beta``, FC-layout weight/bias).
+
+    ``num_kv_heads`` < ``heads`` emits grouped-query attention: the K/V
+    projections are physically ``num_kv_heads * head_dim`` wide (same
+    ``_k``/``_v`` param names — a GQA checkpoint loads by name with the
+    grouped shapes) and the attention op maps each q-head to kv group
+    ``h // G``.  0 (default) keeps the MHA graph byte-identical."""
+    kv_heads = int(num_kv_heads) or heads
+    if heads % kv_heads:
+        raise ValueError(
+            "attention_lm.block: num_heads=%d not divisible by "
+            "num_kv_heads=%d" % (heads, kv_heads))
+    kv_hidden = kv_heads * (embed // heads)
     normed = _normalize(data)
     gamma, beta = _ln_affine(name + "_att", embed)
     q = sym.FusedLNLinear(normed, gamma, beta, num_hidden=embed,
                           name=name + "_q")
-    k = sym.FusedLNLinear(normed, gamma, beta, num_hidden=embed,
+    k = sym.FusedLNLinear(normed, gamma, beta, num_hidden=kv_hidden,
                           name=name + "_k")
-    v = sym.FusedLNLinear(normed, gamma, beta, num_hidden=embed,
+    v = sym.FusedLNLinear(normed, gamma, beta, num_hidden=kv_hidden,
                           name=name + "_v")
-    att = sym.dot_product_attention(q, k, v, num_heads=heads, causal=True)
+    if kv_heads != heads:
+        att = sym.dot_product_attention(q, k, v, num_heads=heads,
+                                        num_kv_heads=kv_heads, causal=True)
+    else:
+        att = sym.dot_product_attention(q, k, v, num_heads=heads,
+                                        causal=True)
     att = sym.FullyConnected(att, num_hidden=embed, flatten=False,
                              name=name + "_attout")
     data = data + att
@@ -91,9 +108,12 @@ def block(data, embed, heads, ffn_hidden, name, moe_experts=0,
 
 def get_symbol(vocab_size, seq_len, num_layers=2, embed=128, heads=4,
                ffn_hidden=512, moe_experts=0, moe_capacity_factor=0.0,
-               moe_top_k=1, **kwargs):
+               moe_top_k=1, num_kv_heads=0, **kwargs):
     """Decoder-only LM: data (B, T) int tokens, softmax over vocab at every
-    position; labels (B, T) next tokens (pad = -1 ignored)."""
+    position; labels (B, T) next tokens (pad = -1 ignored).
+
+    ``num_kv_heads`` (0 = ``heads``) emits grouped-query K/V projections
+    G = heads/num_kv_heads times narrower; the G=1 graph is unchanged."""
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
     net = sym.Embedding(data, input_dim=vocab_size, output_dim=embed,
@@ -105,7 +125,7 @@ def get_symbol(vocab_size, seq_len, num_layers=2, embed=128, heads=4,
         net = block(net, embed, heads, ffn_hidden, "layer%d" % i,
                     moe_experts=moe_experts,
                     moe_capacity_factor=moe_capacity_factor,
-                    moe_top_k=moe_top_k)
+                    moe_top_k=moe_top_k, num_kv_heads=num_kv_heads)
     net = layer_norm(net, embed, "final")
     logits = sym.FullyConnected(sym.Reshape(net, shape=(-1, embed)),
                                 num_hidden=vocab_size, name="head")

@@ -25,36 +25,96 @@ from ..attrs import Param, ParamSchema
 from ..registry import OpDef, register_op
 
 
-def sdpa(q, k, v, num_heads=1, causal=False, scale=None):
+def check_head_groups(num_heads, num_kv_heads, e, ev=None, kv_dim=None,
+                      where="dot_product_attention"):
+    """Validate a (possibly grouped) head configuration, raising
+    ``ValueError``s that NAME the offending dims — the silent-fallthrough
+    guards (``e % heads``, ``heads % kv_heads``) all route through here
+    so every call path fails with the same loud message.
+
+    Returns ``(kv_heads, group)`` with ``kv_heads`` resolved (0 ->
+    ``num_heads``, the MHA default) and ``group = num_heads //
+    kv_heads`` — the GQA/MQA group factor G (Ainslie et al. 2023;
+    Shazeer 2019 at kv_heads == 1)."""
+    heads = int(num_heads)
+    kvh = int(num_kv_heads) or heads
+    if heads <= 0:
+        raise ValueError("%s: num_heads=%d must be positive"
+                         % (where, heads))
+    if kvh <= 0:
+        raise ValueError("%s: num_kv_heads=%d must be positive"
+                         % (where, kvh))
+    if heads % kvh != 0:
+        raise ValueError("%s: num_heads=%d not divisible by "
+                         "num_kv_heads=%d" % (where, heads, kvh))
+    if e % heads != 0:
+        raise ValueError("%s: query embed dim %d not divisible by "
+                         "num_heads=%d" % (where, e, heads))
+    if ev is not None and ev % kvh != 0:
+        raise ValueError("%s: value embed dim %d not divisible by "
+                         "num_kv_heads=%d" % (where, ev, kvh))
+    if kv_dim is not None and kv_dim != kvh * (e // heads):
+        raise ValueError(
+            "%s: key embed dim %d != num_kv_heads=%d * head_dim=%d"
+            % (where, kv_dim, kvh, e // heads))
+    return kvh, heads // kvh
+
+
+def sdpa(q, k, v, num_heads=1, causal=False, scale=None, num_kv_heads=0):
     """Multi-head scaled-dot-product attention kernel.
 
-    (B, Tq, E), (B, Tk, E), (B, Tk, Ev) -> (B, Tq, Ev).  The softmax runs
-    in float32 regardless of the input dtype (bf16-safe accumulation); the
-    output is cast back to the value dtype.  Shared by the registered op
-    and ``parallel.ring.dense_attention`` (one copy of the numerics).
+    (B, Tq, E), (B, Tk, Ek), (B, Tk, Ev) -> (B, Tq, H*hdv).  The softmax
+    runs in float32 regardless of the input dtype (bf16-safe
+    accumulation); the output is cast back to the value dtype.  Shared by
+    the registered op and ``parallel.ring.dense_attention`` (one copy of
+    the numerics).
+
+    ``num_kv_heads`` (0 = ``num_heads``, plain MHA) enables grouped-query
+    attention: K/V carry only ``H_kv`` heads (``Ek == H_kv * hd``) and
+    q-head ``h`` attends kv-head ``h // G`` with ``G = H / H_kv`` —
+    mapped INSIDE the einsum by reshaping q to (B, Tq, H_kv, G, hd), so
+    the G× smaller K/V are never broadcast into a materialized copy.
     """
     import jax.numpy as jnp
 
     b, tq, e = q.shape
     tk = k.shape[1]
     ev = v.shape[2]
-    assert e % num_heads == 0 and ev % num_heads == 0, \
-        "embed dim not divisible by num_heads"
+    kvh, g = check_head_groups(num_heads, num_kv_heads, e, ev, k.shape[2],
+                               where="sdpa")
     hd = e // num_heads
-    qh = q.reshape(b, tq, num_heads, hd)
-    kh = k.reshape(b, tk, num_heads, hd)
-    vh = v.reshape(b, tk, num_heads, ev // num_heads)
     scale = scale or 1.0 / np.sqrt(hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+    if g == 1:
+        # ungrouped path kept verbatim: G=1 stays bit-identical to the
+        # pre-GQA kernel (same einsums in the same order)
+        qh = q.reshape(b, tq, num_heads, hd)
+        kh = k.reshape(b, tk, num_heads, hd)
+        vh = v.reshape(b, tk, num_heads, ev // num_heads)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh,
+                            kh).astype(jnp.float32) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+            logits = jnp.where(mask[None, None], logits,
+                               jnp.finfo(jnp.float32).min)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        p = jnp.exp(logits - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        out = jnp.einsum("bhqk,bkhe->bqhe", p.astype(vh.dtype), vh)
+        return out.reshape(b, tq, ev)
+    qh = q.reshape(b, tq, kvh, g, hd)
+    kh = k.reshape(b, tk, kvh, hd)
+    vh = v.reshape(b, tk, kvh, ev // kvh)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qh,
+                        kh).astype(jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        logits = jnp.where(mask[None, None], logits,
+        logits = jnp.where(mask[None, None, None], logits,
                            jnp.finfo(jnp.float32).min)
     m = jnp.max(logits, axis=-1, keepdims=True)
     p = jnp.exp(logits - m)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bkhe->bqhe", p.astype(vh.dtype), vh)
-    return out.reshape(b, tq, ev)
+    out = jnp.einsum("bhgqk,bkhe->bqhge", p.astype(vh.dtype), vh)
+    return out.reshape(b, tq, num_heads * (ev // kvh))
 
 
 # ---------------------------------------------------------------------------
@@ -109,7 +169,9 @@ def quantize_kv(x, dtype, num_heads=1):
     import jax.numpy as jnp
 
     b, t, e = x.shape
-    assert e % num_heads == 0, "embed dim not divisible by num_heads"
+    if e % num_heads != 0:
+        raise ValueError("quantize_kv: embed dim %d not divisible by "
+                         "num_heads=%d" % (e, num_heads))
     qmax = kv_qmax(dtype)
     xh = x.astype(jnp.float32).reshape(b, t, num_heads, e // num_heads)
     amax = jnp.max(jnp.abs(xh), axis=-1)                      # (B, t, H)
@@ -192,40 +254,70 @@ def cache_append(cache, new, start_pos, num_heads=1):
     return cache.at[jnp.arange(b)[:, None], pos].set(new)
 
 
-def _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale):
+def _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale,
+                num_kv_heads=0):
     """Shared length-masked cache-attention core behind
     :func:`sdpa_decode` (tq == 1) and :func:`sdpa_verify` (tq == k+1).
     Quantized caches (:class:`QuantKV`) dequantize here, per head, before
     the score matmul — the logits are bit-identical to attending the
-    dequantized buffers densely, which is what the parity tests pin."""
+    dequantized buffers densely, which is what the parity tests pin.
+    With ``num_kv_heads < num_heads`` the caches hold H_kv heads (and
+    QuantKV scale planes are per-(token, kv-head)); q-head ``h`` scores
+    kv-head ``h // G`` through the grouped einsum — no broadcast copy."""
     import jax.numpy as jnp
 
-    k_cache = dequantize_kv(k_cache, num_heads)
-    v_cache = dequantize_kv(v_cache, num_heads)
     b, tq, e = q.shape
+    kvh, g = check_head_groups(num_heads, num_kv_heads, e,
+                               where="sdpa_decode")
+    k_cache = dequantize_kv(k_cache, kvh)
+    v_cache = dequantize_kv(v_cache, kvh)
     c = k_cache.shape[1]
     ev = v_cache.shape[2]
-    assert e % num_heads == 0 and ev % num_heads == 0, \
-        "embed dim not divisible by num_heads"
+    if ev % kvh != 0:
+        raise ValueError("sdpa_decode: value cache dim %d not divisible "
+                         "by num_kv_heads=%d" % (ev, kvh))
     hd = e // num_heads
-    qh = q.reshape(b, tq, num_heads, hd)
-    kh = k_cache.reshape(b, c, num_heads, hd)
-    vh = v_cache.reshape(b, c, num_heads, ev // num_heads)
+    if k_cache.shape[2] != kvh * hd:
+        raise ValueError(
+            "sdpa_decode: key cache dim %d != num_kv_heads=%d * "
+            "head_dim=%d" % (k_cache.shape[2], kvh, hd))
     scale = scale or 1.0 / np.sqrt(hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
-    total = jnp.asarray(total_len, jnp.int32).reshape(-1, 1, 1, 1)
-    qpos = jnp.arange(tq, dtype=jnp.int32).reshape(1, 1, tq, 1)
+    if g == 1:
+        # ungrouped path kept verbatim (G=1 bit-identity)
+        qh = q.reshape(b, tq, num_heads, hd)
+        kh = k_cache.reshape(b, c, num_heads, hd)
+        vh = v_cache.reshape(b, c, num_heads, ev // num_heads)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh,
+                            kh).astype(jnp.float32) * scale
+        total = jnp.asarray(total_len, jnp.int32).reshape(-1, 1, 1, 1)
+        qpos = jnp.arange(tq, dtype=jnp.int32).reshape(1, 1, tq, 1)
+        limit = jnp.minimum(total - (tq - 1) + qpos, c)
+        slot = jnp.arange(c, dtype=jnp.int32).reshape(1, 1, 1, c)
+        logits = jnp.where(slot < limit, logits, jnp.finfo(jnp.float32).min)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        p = jnp.exp(logits - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        out = jnp.einsum("bhqk,bkhe->bqhe", p.astype(vh.dtype), vh)
+        return out.reshape(b, tq, ev)
+    qh = q.reshape(b, tq, kvh, g, hd)
+    kh = k_cache.reshape(b, c, kvh, hd)
+    vh = v_cache.reshape(b, c, kvh, ev // kvh)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qh,
+                        kh).astype(jnp.float32) * scale
+    total = jnp.asarray(total_len, jnp.int32).reshape(-1, 1, 1, 1, 1)
+    qpos = jnp.arange(tq, dtype=jnp.int32).reshape(1, 1, 1, tq, 1)
     limit = jnp.minimum(total - (tq - 1) + qpos, c)
-    slot = jnp.arange(c, dtype=jnp.int32).reshape(1, 1, 1, c)
+    slot = jnp.arange(c, dtype=jnp.int32).reshape(1, 1, 1, 1, c)
     logits = jnp.where(slot < limit, logits, jnp.finfo(jnp.float32).min)
     m = jnp.max(logits, axis=-1, keepdims=True)
     p = jnp.exp(logits - m)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bkhe->bqhe", p.astype(vh.dtype), vh)
-    return out.reshape(b, tq, ev)
+    out = jnp.einsum("bhgqk,bkhe->bqhge", p.astype(vh.dtype), vh)
+    return out.reshape(b, tq, num_heads * (ev // kvh))
 
 
-def sdpa_decode(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
+def sdpa_decode(q, k_cache, v_cache, total_len, num_heads=1, scale=None,
+                num_kv_heads=0):
     """Attend query position(s) against a ring-buffer KV cache.
 
     (B, tq, E) queries over (B, C, E)/(B, C, Ev) caches -> (B, tq, Ev).
@@ -239,10 +331,12 @@ def sdpa_decode(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
     tq > 1 the caller must not have wrapped past its own queries
     (total <= C) — that multi-position form is :func:`sdpa_verify`.
     """
-    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale)
+    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale,
+                       num_kv_heads=num_kv_heads)
 
 
-def sdpa_verify(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
+def sdpa_verify(q, k_cache, v_cache, total_len, num_heads=1, scale=None,
+                num_kv_heads=0):
     """Length-masked multi-position cache attention — the speculative
     verify kernel.
 
@@ -258,7 +352,8 @@ def sdpa_verify(q, k_cache, v_cache, total_len, num_heads=1, scale=None):
     decode layer gates speculation off near the ring boundary and falls
     back to single-token steps, keeping every shape static.
     """
-    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale)
+    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale,
+                       num_kv_heads=num_kv_heads)
 
 
 # ---------------------------------------------------------------------------
@@ -399,7 +494,7 @@ def decode_kernel_mode():
 
 
 def paged_attend(q, k_pool, v_pool, table, total_len, num_heads=1,
-                 scale=None, mesh_active=False):
+                 scale=None, mesh_active=False, num_kv_heads=0):
     """Decode/verify attention over shared page pools — the ONE entry the
     decode programs call.
 
@@ -418,22 +513,23 @@ def paged_attend(q, k_pool, v_pool, table, total_len, num_heads=1,
         from . import pallas_decode as _pd
 
         if _pd.supported(q.shape, k_pool, v_pool, table.shape, num_heads,
-                         interpret=interp):
+                         interpret=interp, num_kv_heads=num_kv_heads):
             DECODE_PATH["last"] = "pallas"
             fn = _pd.flash_sdpa_decode if q.shape[1] == 1 \
                 else _pd.flash_sdpa_verify
             return fn(q, k_pool, v_pool, table, total_len,
-                      num_heads=num_heads, scale=scale, interpret=interp)
+                      num_heads=num_heads, scale=scale, interpret=interp,
+                      num_kv_heads=num_kv_heads)
         DECODE_PATH["last"] = "einsum-gated"
     else:
         DECODE_PATH["last"] = "einsum"
     return _sdpa_cache(q, paged_gather(k_pool, table),
                        paged_gather(v_pool, table), total_len, num_heads,
-                       scale)
+                       scale, num_kv_heads=num_kv_heads)
 
 
 def cache_attend(q, k_cache, v_cache, total_len, num_heads=1, scale=None,
-                 mesh_active=False):
+                 mesh_active=False, num_kv_heads=0):
     """Decode/verify attention over dense (B, C, E) ring buffers — the
     non-paged twin of :func:`paged_attend`.  The fused path is the SAME
     kernel through an identity page table
@@ -445,15 +541,18 @@ def cache_attend(q, k_cache, v_cache, total_len, num_heads=1, scale=None,
         from . import pallas_decode as _pd
 
         if _pd.supported_dense(q.shape, k_cache, v_cache, num_heads,
-                               interpret=interp):
+                               interpret=interp,
+                               num_kv_heads=num_kv_heads):
             DECODE_PATH["last"] = "pallas"
             return _pd.dense_ring_attend(q, k_cache, v_cache, total_len,
                                          num_heads=num_heads, scale=scale,
-                                         interpret=interp)
+                                         interpret=interp,
+                                         num_kv_heads=num_kv_heads)
         DECODE_PATH["last"] = "einsum-gated"
     else:
         DECODE_PATH["last"] = "einsum"
-    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale)
+    return _sdpa_cache(q, k_cache, v_cache, total_len, num_heads, scale,
+                       num_kv_heads=num_kv_heads)
 
 
 _KV_LAYOUT_WARNED = {"done": False}
@@ -520,9 +619,14 @@ def apply_kv_layout(buf, device=None):
 
 def _attn_shape(attrs, in_shapes, aux_shapes):
     q, k, v = in_shapes
-    assert q[-1] == k[-1], "query/key embed dims differ"
+    heads = attrs.get("num_heads", 1)
+    kvh, _ = check_head_groups(heads, attrs.get("num_kv_heads", 0),
+                               q[-1], v[-1], k[-1],
+                               where="dot_product_attention")
     assert k[0] == v[0] and k[1] == v[1], "key/value (B, T) differ"
-    out = (q[0], q[1], v[-1])
+    # grouped K/V carry H_kv heads of width hdv each; the output is one
+    # hdv-wide slice per Q head (v[-1] itself when H_kv == H)
+    out = (q[0], q[1], heads * (v[-1] // kvh))
     return [tuple(q), tuple(k), tuple(v)], [out], []
 
 
@@ -530,8 +634,14 @@ def register_all():
     def _compute_full(attrs, inputs, aux, octx):
         q, k, v = inputs
         heads = attrs.get("num_heads", 1)
+        kv_heads = attrs.get("num_kv_heads", 0) or heads
         causal = attrs.get("causal", False)
         scale = attrs.get("scale", 0.0) or None
+        # malformed head configs (e % heads, heads % kv_heads, grouped
+        # K/V width mismatch) raise HERE, before any dispatch — they used
+        # to fall through silently until some downstream reshape tripped
+        check_head_groups(heads, kv_heads, q.shape[2], v.shape[2],
+                          k.shape[2], where="dot_product_attention")
         from .. import config as _config
 
         # mesh path: with the time axis sharded on 'seq', run
@@ -549,16 +659,15 @@ def register_all():
             b, tq, e = q.shape
             seq_par = mesh_axes.get("seq", 1)
             model_par = mesh_axes.get("model", 1)
-            # e % heads (and the value dim alike) must hold BEFORE taking
-            # the shard_map path: a malformed head config must fall through
-            # to the einsum kernel's explicit assert, not surface as a
-            # reshape trace error inside the ring region.  heads % model
-            # keeps head groups whole per model shard; indivisible configs
-            # degrade to the GSPMD einsum, never to wrong numbers.
+            # malformed head configs already raised above (ValueError
+            # naming the dims); what remains here are legitimate DEGRADE
+            # conditions: heads % model (and kv_heads % model — a grouped
+            # E-split is an H_kv-split on K/V) keep head groups whole per
+            # model shard; indivisible configs degrade to the GSPMD
+            # einsum, never to wrong numbers.
             if (seq_par > 1 and k.shape[1] == tq and v.shape[1] == tq
-                    and heads > 0 and e % heads == 0
-                    and v.shape[2] % heads == 0
                     and heads % model_par == 0
+                    and kv_heads % model_par == 0
                     and tq % seq_par == 0
                     and b % mesh_axes.get("data", 1) == 0):
                 from jax.sharding import PartitionSpec as P
@@ -578,7 +687,7 @@ def register_all():
                     lambda q_, k_, v_: ring_attention(
                         q_, k_, v_, axis_name="seq", num_heads=heads,
                         causal=causal, scale=scale, head_axis=model_ax,
-                        double_buffer=dbuf),
+                        double_buffer=dbuf, num_kv_heads=kv_heads),
                     mesh=octx.mesh, in_specs=(spec,) * 3, out_specs=spec,
                     check_vma=False)
                 PATH_TAKEN["last"] = "ring"
@@ -600,19 +709,24 @@ def register_all():
             interpret = bool(_config.get("MXNET_PALLAS_INTERPRET"))
             on_tpu = jax.default_backend() == "tpu"
             if (on_tpu or interpret) \
-                    and _pa.supported(q.shape, k.shape, causal, heads):
+                    and _pa.supported(q.shape, k.shape, causal, heads,
+                                      num_kv_heads=kv_heads):
                 PATH_TAKEN["last"] = "flash"
                 out = _pa.sdpa_flash(q, k, v, heads, causal, scale,
-                                     interpret=interpret and not on_tpu)
+                                     interpret=interpret and not on_tpu,
+                                     num_kv_heads=kv_heads)
                 return [out], []
         PATH_TAKEN["last"] = "einsum"
         return [sdpa(q, k, v, num_heads=heads, causal=causal,
-                     scale=scale)], []
+                     scale=scale, num_kv_heads=kv_heads)], []
 
     register_op(OpDef(
         "dot_product_attention", _compute_full,
         schema=ParamSchema(
             Param("num_heads", int, default=1),
+            Param("num_kv_heads", int, default=0,
+                  doc="grouped-query attention: K/V head count "
+                      "(must divide num_heads); 0 = num_heads (MHA)"),
             Param("causal", bool, default=False),
             Param("scale", float, default=0.0,
                   doc="0 = 1/sqrt(head_dim)"),

@@ -58,17 +58,45 @@ def _pick_block(pref, t):
     return b if b >= MIN_BLOCK and t % b == 0 else 0
 
 
-def _tuned(t, d, dtype):
+# grouped shape classes whose stale-MHA-record check already ran (the
+# warned-miss fires once per shape class per process, not per trace)
+_STALE_GROUP_CHECKED = set()
+
+
+def _tuned(t, d, dtype, groups=1):
     """Tuning-cache block resolution for this shape class ({"block_q",
     "block_k", "block_q_bwd", "block_k_bwd"}; the module constants when
-    cold and no sweep armed)."""
+    cold and no sweep armed).
+
+    The kv-head group factor is part of the content-addressed key
+    (``g<G>`` joins the shape class) — a grouped kernel's winning blocks
+    see G× narrower K/V streams than the MHA kernel's at the same (t, d),
+    so GQA shapes must never collide with MHA winners.  A persisted
+    MHA-keyed record encountered for a grouped shape reads as a WARNED
+    miss, never as a hit."""
     import jax.numpy as jnp
 
     from . import tuning
 
-    return tuning.resolve("pallas_attention",
-                          tuning.shape_class_for(t=t, d=d),
-                          jnp.dtype(dtype).name)
+    name = jnp.dtype(dtype).name
+    if groups <= 1:
+        return tuning.resolve("pallas_attention",
+                              tuning.shape_class_for(t=t, d=d), name)
+    sc = tuning.shape_class_for(t=t, d=d, g=groups)
+    if sc not in _STALE_GROUP_CHECKED:
+        _STALE_GROUP_CHECKED.add(sc)
+        if tuning.get("pallas_attention", sc, name, version=1) is None \
+                and tuning.get("pallas_attention",
+                               tuning.shape_class_for(t=t, d=d), name,
+                               version=1) is not None:
+            import warnings
+
+            warnings.warn(
+                "tuning cache holds an MHA-keyed pallas_attention record "
+                "for t=%d d=%d but the shape is grouped (G=%d); the MHA "
+                "winner does not apply — treating as a miss" %
+                (t, d, groups))
+    return tuning.resolve("pallas_attention", sc, name)
 
 
 def _out_sds(shape, dtype, *inputs):
@@ -176,15 +204,20 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
 
 
 def _fwd_call(q, k, v, scale, causal, interpret, with_lse, block_q=None,
-              block_k=None):
+              block_k=None, groups=1):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q.shape
+    g = int(groups)
+    if k.shape[0] * g != bh:
+        raise ValueError(
+            "flash_attention fwd: folded K/V batch %d * groups=%d != "
+            "folded Q batch %d" % (k.shape[0], g, bh))
     if block_q is None or block_k is None:
-        cfg = _tuned(t, d, q.dtype)
+        cfg = _tuned(t, d, q.dtype, groups=g)
         block_q = block_q or cfg.get("block_q", BLOCK_Q)
         block_k = block_k or cfg.get("block_k", BLOCK_K)
     bq = _pick_block(block_q, t)
@@ -193,6 +226,15 @@ def _fwd_call(q, k, v, scale, causal, interpret, with_lse, block_q=None,
         raise ValueError("flash_attention fwd blocks degenerate for T=%d "
                          "(callers must gate on supported())" % t)
     grid = (bh, t // bq, t // bk)
+
+    # grouped K/V: folded Q batch index b encodes (batch, q-head) as
+    # b = batch*H + h, so its kv block lives at folded index
+    # batch*H_kv + h//G == b // G — the h // G group map, in the
+    # BlockSpec index map (never a materialized broadcast)
+    if g == 1:
+        kv_map = lambda b, i, j: (b, j, 0)          # noqa: E731
+    else:
+        kv_map = lambda b, i, j: (b // g, j, 0)     # noqa: E731
 
     kernel = functools.partial(_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk, with_lse=with_lse)
@@ -209,8 +251,8 @@ def _fwd_call(q, k, v, scale, causal, interpret, with_lse, block_q=None,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), kv_map),
+            pl.BlockSpec((1, bk, d), kv_map),
         ],
         out_specs=out_specs,
         scratch_shapes=[
@@ -336,16 +378,74 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dk_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_dkv_kernel_grouped(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref,
+                            dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                            causal, block_q, block_k):
+    """Grouped twin of :func:`_bwd_dkv_kernel`: the grid grows a trailing
+    group dim (B*H_kv, T/bk, T/bq, G) and the VMEM scratch accumulates
+    every one of a kv head's G q-heads' contributions before the single
+    write-back — dK/dV land at the GROUPED width, no q-width gradient is
+    ever materialized."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)   # key block (outer)
+    i = pl.program_id(2)   # query block (accumulated)
+    gi = pl.program_id(3)  # q-head within the kv group (accumulated)
+    ni = pl.num_programs(2)
+    ng = pl.num_programs(3)
+
+    @pl.when((i == 0) & (gi == 0))
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _update():
+        import jax
+
+        p, ds = _recompute_p_ds(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref), i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        q = q_ref[0]
+        do = do_ref[0]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(i * block_q + block_q - 1 >= j * block_k)
+        def _masked_update():
+            _update()
+    else:
+        _update()
+
+    @pl.when((i == ni - 1) & (gi == ng - 1))
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret, block_q=None,
-              block_k=None):
+              block_k=None, groups=1):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q.shape
+    g = int(groups)
+    bh_kv = k.shape[0]
+    if bh_kv * g != bh:
+        raise ValueError(
+            "flash_attention bwd: folded K/V batch %d * groups=%d != "
+            "folded Q batch %d" % (bh_kv, g, bh))
     if block_q is None or block_k is None:
-        cfg = _tuned(t, d, q.dtype)
+        cfg = _tuned(t, d, q.dtype, groups=g)
         block_q = block_q or cfg.get("block_q_bwd", BLOCK_Q_BWD)
         block_k = block_k or cfg.get("block_k_bwd", BLOCK_K_BWD)
     bq = _pick_block(block_q, t)
@@ -358,6 +458,11 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret, block_q=None,
                     axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (bh, t, LANES))
 
+    if g == 1:
+        kv_map = lambda b, i, j: (b, j, 0)          # noqa: E731
+    else:
+        kv_map = lambda b, i, j: (b // g, j, 0)     # noqa: E731
+
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
                                   causal=causal, block_q=bq, block_k=bk)
     dq = pl.pallas_call(
@@ -366,8 +471,8 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret, block_q=None,
         grid=(bh, t // bq, t // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),       # q
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),       # k
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),       # v
+            pl.BlockSpec((1, bk, d), kv_map),                          # k
+            pl.BlockSpec((1, bk, d), kv_map),                          # v
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),       # do
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),   # lse
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),   # dta
@@ -377,24 +482,62 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, interpret, block_q=None,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+    if g == 1:
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                       causal=causal, block_q=bq,
+                                       block_k=bk)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            out_shape=[
+                _out_sds(k.shape, k.dtype, q, k, v, do, lse, delta),
+                _out_sds(v.shape, v.dtype, q, k, v, do, lse, delta)],
+            grid=(bh, t // bk, t // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, bq, LANES),
+                             lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, bq, LANES),
+                             lambda b, j, i: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        return dq, dk, dv
+
+    # grouped dK/dV: grid walks (kv batch, key block, query block, group
+    # member) — the b axis is the FOLDED KV batch, q/do/residual blocks
+    # index q-head b*G + gi, and the scratch accumulates across both i
+    # and gi before one grouped-width write-back
+    dkv_kernel = functools.partial(_bwd_dkv_kernel_grouped, scale=scale,
                                    causal=causal, block_q=bq, block_k=bk)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[_out_sds(k.shape, k.dtype, q, k, v, do, lse, delta),
                    _out_sds(v.shape, v.dtype, q, k, v, do, lse, delta)],
-        grid=(bh, t // bk, t // bq),
+        grid=(bh_kv, t // bk, t // bq, g),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # q
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # k
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # v
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # do
-            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, 0)),   # lse
-            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, 0)),   # dta
+            pl.BlockSpec((1, bq, d),
+                         lambda b, j, i, gi: (b * g + gi, i, 0)),      # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i, gi: (b, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i, gi: (b, j, 0)),   # v
+            pl.BlockSpec((1, bq, d),
+                         lambda b, j, i, gi: (b * g + gi, i, 0)),      # do
+            pl.BlockSpec((1, bq, LANES),
+                         lambda b, j, i, gi: (b * g + gi, i, 0)),      # lse
+            pl.BlockSpec((1, bq, LANES),
+                         lambda b, j, i, gi: (b * g + gi, i, 0)),      # dta
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, gi: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, gi: (b, j, 0)),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
@@ -412,33 +555,46 @@ def _flash_vjp():
         return _VJP_CACHE["fn"]
     import jax
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-    def _flash(q, k, v, scale, causal, interpret):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+    def _flash(q, k, v, scale, causal, interpret, groups):
         out, _ = _fwd_call(q, k, v, scale, causal, interpret,
-                           with_lse=False)
+                           with_lse=False, groups=groups)
         return out
 
-    def _fwd_rule(q, k, v, scale, causal, interpret):
+    def _fwd_rule(q, k, v, scale, causal, interpret, groups):
         out, lse = _fwd_call(q, k, v, scale, causal, interpret,
-                             with_lse=True)
+                             with_lse=True, groups=groups)
         return out, (q, k, v, out, lse)
 
-    def _bwd_rule(scale, causal, interpret, res, do):
+    def _bwd_rule(scale, causal, interpret, groups, res, do):
         q, k, v, out, lse = res
-        return _bwd_call(q, k, v, out, lse, do, scale, causal, interpret)
+        return _bwd_call(q, k, v, out, lse, do, scale, causal, interpret,
+                         groups=groups)
 
     _flash.defvjp(_fwd_rule, _bwd_rule)
     _VJP_CACHE["fn"] = _flash
     return _flash
 
 
-def _einsum_fallback(q, k, v, scale, causal):
+def _einsum_fallback(q, k, v, scale, causal, groups=1):
     """Plain-XLA attention with the kernel's numerics contract, for
     shapes whose blocks degenerate (odd/prime T); differentiable through
-    ordinary autodiff."""
+    ordinary autodiff.  ``groups`` > 1 maps folded q row ``b`` onto K/V
+    row ``b // groups`` via reshape, like the kernel's index maps."""
     import jax
     import jax.numpy as jnp
 
+    if groups > 1:
+        bh, t, d = q.shape
+        qg = q.reshape(bh // groups, groups, t, d)
+        s = jnp.einsum("bgqd,bkd->bgqk", qg.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bgqk,bkd->bgqd", p, v.astype(jnp.float32))
+        return out.reshape(bh, t, d).astype(q.dtype)
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -450,9 +606,11 @@ def _einsum_fallback(q, k, v, scale, causal):
         .astype(q.dtype)
 
 
-def flash_attention(q, k, v, scale, causal=False, interpret=False):
-    """(BH, T, D) q/k/v -> (BH, T, D) attention output.  Differentiable
-    (custom_vjp over the backward kernels — training runs the flash path).
+def flash_attention(q, k, v, scale, causal=False, interpret=False,
+                    groups=1):
+    """(BH, T, D) q vs (BH_kv, T, D) k/v -> (BH, T, D) attention output
+    (``BH_kv == BH`` at ``groups=1``).  Differentiable (custom_vjp over
+    the backward kernels — training runs the flash path).
 
     T whose block shrink degenerates below :data:`MIN_BLOCK` (odd or
     prime T — formerly a pathological 1-row kernel) takes the einsum
@@ -461,19 +619,22 @@ def flash_attention(q, k, v, scale, causal=False, interpret=False):
     if not (_pick_block(BLOCK_Q, t) and _pick_block(BLOCK_K, t)
             and _pick_block(BLOCK_Q_BWD, t)
             and _pick_block(BLOCK_K_BWD, t)):
-        return _einsum_fallback(q, k, v, float(scale), bool(causal))
+        return _einsum_fallback(q, k, v, float(scale), bool(causal),
+                                groups=int(groups))
     return _flash_vjp()(q, k, v, float(scale), bool(causal),
-                        bool(interpret))
+                        bool(interpret), int(groups))
 
 
-def supported(q_shape, k_shape, causal, num_heads=1):
+def supported(q_shape, k_shape, causal, num_heads=1, num_kv_heads=0):
     """Whether the kernel handles these shapes (self-attention, T a
     multiple of the 128 sublane/lane tile, lane-friendly head dim).
     ``_pick_block`` shrinks the preferred block sizes to divide any such
     T, so 128-alignment is the only sequence-length constraint.  The lane
     check is on the PER-HEAD dim (E/num_heads) — the kernel operates on
     head-folded (B*H, T, E/H) blocks, so E=512/H=16 (head_dim 32) must
-    fall back even though E itself is lane-aligned."""
+    fall back even though E itself is lane-aligned.  Grouped configs
+    (``num_kv_heads < num_heads``) additionally require the K width to be
+    exactly H_kv head slices."""
     bh, tq, d = q_shape
     tk = k_shape[1]
     if tq != tk:                       # cross-attention: fallback
@@ -481,6 +642,11 @@ def supported(q_shape, k_shape, causal, num_heads=1):
     if tq % 128:                       # tile-aligned T only
         return False
     if num_heads <= 0 or d % num_heads:
+        return False
+    kvh = int(num_kv_heads) or int(num_heads)
+    if kvh <= 0 or num_heads % kvh:
+        return False
+    if k_shape[2] != kvh * (d // num_heads):
         return False
     if (d // num_heads) % 64 != 0:     # lane-unfriendly heads: fallback
         return False
@@ -493,19 +659,25 @@ def supported(q_shape, k_shape, causal, num_heads=1):
     return True
 
 
-def sdpa_flash(q, k, v, num_heads, causal, scale, interpret=False):
+def sdpa_flash(q, k, v, num_heads, causal, scale, interpret=False,
+               num_kv_heads=0):
     """Multi-head wrapper matching ops.attention.sdpa's contract:
-    (B, T, E) -> (B, T, E) with heads folded into the batch dim."""
+    (B, T, E) -> (B, T, E) with heads folded into the batch dim.
+    Grouped configs fold K/V at their physical H_kv count — the kernels
+    map q-head ``h`` to kv block ``h // G`` in their index maps."""
     b, t, e = q.shape
+    kvh = int(num_kv_heads) or int(num_heads)
+    g = num_heads // kvh
     hd = e // num_heads
     scale = scale or 1.0 / np.sqrt(hd)
 
-    def fold(x):
-        return x.reshape(b, t, num_heads, hd).transpose(0, 2, 1, 3) \
-            .reshape(b * num_heads, t, hd)
+    def fold(x, h):
+        return x.reshape(b, t, h, x.shape[2] // h).transpose(0, 2, 1, 3) \
+            .reshape(b * h, t, x.shape[2] // h)
 
-    out = flash_attention(fold(q), fold(k), fold(v), scale=float(scale),
-                          causal=bool(causal), interpret=bool(interpret))
+    out = flash_attention(fold(q, num_heads), fold(k, kvh), fold(v, kvh),
+                          scale=float(scale), causal=bool(causal),
+                          interpret=bool(interpret), groups=g)
     return out.reshape(b, num_heads, t, hd).transpose(0, 2, 1, 3) \
         .reshape(b, t, e)
 

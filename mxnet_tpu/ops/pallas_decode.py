@@ -75,25 +75,48 @@ _TPU_LANE = 128
 _TPU_SUBLANE = 8
 
 
-def _num_splits(m, cap=None):
+def _num_splits(m, cap=None, groups=1):
     """Largest power-of-two split count <= min(m, cap) that divides m
     (1 when m is odd — the split axis degrades gracefully).  ``cap``
     defaults to the tuning cache's ``max_splits`` for this view width
     (the :data:`MAX_SPLITS` constant when cold and no sweep armed)."""
     if cap is None:
-        cap = _tuned_split_cap(m)
+        cap = _tuned_split_cap(m, groups=groups)
     s = 1
     while s * 2 <= min(m, cap) and m % (s * 2) == 0:
         s *= 2
     return s
 
 
-def _tuned_split_cap(m):
+_STALE_GROUP_CHECKED = set()
+
+
+def _tuned_split_cap(m, groups=1):
     from . import tuning
 
     # split width is a parallelism knob, not a dtype-layout one: one
     # decision per view width serves every pool dtype
-    return int(tuning.resolve("pallas_decode", tuning.shape_class_for(m=m),
+    if groups <= 1:
+        return int(tuning.resolve("pallas_decode",
+                                  tuning.shape_class_for(m=m),
+                                  "any").get("max_splits", MAX_SPLITS))
+    # grouped K/V shapes get their own content-addressed tune key (the
+    # kv-head group class rides in the shape class) so a GQA sweep never
+    # collides with an MHA winner for the same view width
+    sc = tuning.shape_class_for(m=m, g=groups)
+    if sc not in _STALE_GROUP_CHECKED:
+        _STALE_GROUP_CHECKED.add(sc)
+        mha_sc = tuning.shape_class_for(m=m)
+        if (tuning.get("pallas_decode", sc, "any", version=1) is None
+                and tuning.get("pallas_decode", mha_sc, "any",
+                               version=1) is not None):
+            import warnings
+
+            warnings.warn(
+                "tuning cache holds an MHA-keyed pallas_decode record for "
+                "m=%d but the shape is grouped (G=%d); the MHA winner "
+                "does not apply — treating as a miss" % (m, groups))
+    return int(tuning.resolve("pallas_decode", sc,
                               "any").get("max_splits", MAX_SPLITS))
 
 
@@ -104,32 +127,38 @@ def _is_quant(pool):
 
 
 def supported(q_shape, k_pool, v_pool, table_shape, num_heads,
-              interpret=False):
+              interpret=False, num_kv_heads=0):
     """Whether the fused kernel handles this paged-decode shape.
 
     Correctness constraints always: heads divide both embed dims and the
-    (quantized) scale planes carry exactly ``num_heads``.  On a real TPU
-    (``interpret=False``) the Mosaic tile constraints add: per-head dims
-    and page_tokens aligned to the (8, 128) tile.  Anything else falls
-    back to the einsum path — same numerics, three HBM passes.
+    (quantized) scale planes carry exactly the K/V head count.  Grouped
+    configs (``num_kv_heads < num_heads``) require the pools to be
+    physically H_kv heads wide — the kernel maps q-head h to pool slice
+    ``h // G``.  On a real TPU (``interpret=False``) the Mosaic tile
+    constraints add: per-head dims and page_tokens aligned to the
+    (8, 128) tile.  Anything else falls back to the einsum path — same
+    numerics, three HBM passes.
     """
     kd = k_pool.data if _is_quant(k_pool) else k_pool
     vd = v_pool.data if _is_quant(v_pool) else v_pool
     b, tq, e = q_shape
-    if num_heads <= 0 or e % num_heads or vd.shape[2] % num_heads:
+    kvh = int(num_kv_heads) or int(num_heads)
+    if num_heads <= 0 or kvh <= 0 or num_heads % kvh:
         return False
-    if kd.shape[2] != e:
+    if e % num_heads or vd.shape[2] % kvh:
         return False
-    if _is_quant(k_pool) and k_pool.scale.shape[-1] != num_heads:
+    if kd.shape[2] != kvh * (e // num_heads):
         return False
-    if _is_quant(v_pool) and v_pool.scale.shape[-1] != num_heads:
+    if _is_quant(k_pool) and k_pool.scale.shape[-1] != kvh:
+        return False
+    if _is_quant(v_pool) and v_pool.scale.shape[-1] != kvh:
         return False
     pt = kd.shape[1]
     if pt <= 0 or table_shape[1] <= 0:
         return False
     if not interpret:
         hd_k = e // num_heads
-        hd_v = vd.shape[2] // num_heads
+        hd_v = vd.shape[2] // kvh
         if hd_k % _TPU_LANE or hd_v % _TPU_LANE:
             return False
         if pt % _TPU_SUBLANE:
@@ -214,10 +243,15 @@ def _kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 
 def _paged_flash_call(q, k_pool, v_pool, table, lens, num_heads, scale,
-                      interpret, split_cap=None):
+                      interpret, split_cap=None, num_kv_heads=0):
     """Launch the kernel and combine split partials; returns (B, tq, Ev)
     in the V pool's compute dtype (f32 for quantized pools, matching the
-    einsum path's dequantized output)."""
+    einsum path's dequantized output).
+
+    Grouped pools (``num_kv_heads < num_heads``) keep the (b, h, s, ms)
+    q-head grid; the pool/scale BlockSpec index maps gather ONE kv-head
+    slice per G q-heads (``hi // G`` — the group id), so the pool is
+    never widened to H_q."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -228,11 +262,13 @@ def _paged_flash_call(q, k_pool, v_pool, table, lens, num_heads, scale,
     vd = v_pool.data if quant else v_pool
     b, tq, e = q.shape
     h = num_heads
+    kvh = int(num_kv_heads) or int(h)
+    g = h // kvh
     hd_k = e // h
-    hd_v = vd.shape[2] // h
+    hd_v = vd.shape[2] // kvh
     pt = kd.shape[1]
     m = table.shape[1]
-    s = _num_splits(m, split_cap)
+    s = _num_splits(m, split_cap, groups=g)
     ms = m // s
     scale = float(scale or 1.0 / np.sqrt(hd_k))
 
@@ -249,8 +285,14 @@ def _paged_flash_call(q, k_pool, v_pool, table, lens, num_heads, scale,
     def _q_map(bi, hi, si, mi, tr, lr):
         return (bi, hi, 0, 0)
 
-    def _page_map(bi, hi, si, mi, tr, lr):
-        return (tr[bi, si * ms + mi], 0, hi)
+    if g == 1:
+        def _page_map(bi, hi, si, mi, tr, lr):
+            return (tr[bi, si * ms + mi], 0, hi)
+    else:
+        # pool blocks keyed by GROUP id: q-heads hi in [gi*G, (gi+1)*G)
+        # all DMA kv-head slice gi = hi // G of the physically-grouped pool
+        def _page_map(bi, hi, si, mi, tr, lr):
+            return (tr[bi, si * ms + mi], 0, hi // g)
 
     def _out_map(bi, hi, si, mi, tr, lr):
         return (bi, hi, si, 0, 0)
@@ -326,23 +368,27 @@ def _paged_flash_call(q, k_pool, v_pool, table, lens, num_heads, scale,
 
 
 def flash_sdpa_decode(q, k_pool, v_pool, table, total_len, num_heads=1,
-                      scale=None, interpret=False, split_cap=None):
-    """Fused paged decode attention: (B, 1, E) queries over (P, pt, E)
+                      scale=None, interpret=False, split_cap=None,
+                      num_kv_heads=0):
+    """Fused paged decode attention: (B, 1, E) queries over (P, pt, E_kv)
     pools through (B, M) page tables -> (B, 1, Ev).
 
     ``total_len`` counts tokens appended INCLUDING the query position
     (the ``sdpa_decode`` contract); once the view ring has wrapped
     (total > M*pt) every slot is live.  Pools may be
     :class:`~mxnet_tpu.ops.attention.QuantKV` — dequantized per
-    (token, head) in VMEM.  One HBM pass over the live pool pages.
+    (token, kv-head) in VMEM.  One HBM pass over the live pool pages;
+    grouped pools (``num_kv_heads``) are walked once per kv head group.
     """
     return _paged_flash_call(q, k_pool, v_pool, table, total_len,
                              num_heads, scale, interpret,
-                             split_cap=split_cap)
+                             split_cap=split_cap,
+                             num_kv_heads=num_kv_heads)
 
 
 def flash_sdpa_verify(q, k_pool, v_pool, table, total_len, num_heads=1,
-                      scale=None, interpret=False, split_cap=None):
+                      scale=None, interpret=False, split_cap=None,
+                      num_kv_heads=0):
     """Fused paged multi-position cache attention — the speculative
     verify window (tq = k+1) and the chunked-prefill window (tq = chunk
     width) share it.  Query i masks to view slots
@@ -351,7 +397,8 @@ def flash_sdpa_verify(q, k_pool, v_pool, table, total_len, num_heads=1,
     """
     return _paged_flash_call(q, k_pool, v_pool, table, total_len,
                              num_heads, scale, interpret,
-                             split_cap=split_cap)
+                             split_cap=split_cap,
+                             num_kv_heads=num_kv_heads)
 
 
 def _dense_block(c, pt_pref=128):
@@ -374,7 +421,8 @@ class _Shape:
         self.dtype = dtype
 
 
-def supported_dense(q_shape, k_cache, v_cache, num_heads, interpret=False):
+def supported_dense(q_shape, k_cache, v_cache, num_heads, interpret=False,
+                    num_kv_heads=0):
     """Whether the dense-ring variant handles these cache shapes: the
     (B, C, E) ring must tile into identity pages the paged gate accepts."""
     from .attention import QuantKV
@@ -393,11 +441,12 @@ def supported_dense(q_shape, k_cache, v_cache, num_heads, interpret=False):
                       cache.dtype)
 
     return supported(q_shape, as_pool(k_cache), as_pool(v_cache),
-                     (q_shape[0], mb), num_heads, interpret=interpret)
+                     (q_shape[0], mb), num_heads, interpret=interpret,
+                     num_kv_heads=num_kv_heads)
 
 
 def dense_ring_attend(q, k_cache, v_cache, total_len, num_heads=1,
-                      scale=None, interpret=False):
+                      scale=None, interpret=False, num_kv_heads=0):
     """The dense-ring variant: run the SAME fused kernel over a non-paged
     (B, C, E) ring buffer through an identity page table.
 
@@ -423,7 +472,8 @@ def dense_ring_attend(q, k_cache, v_cache, total_len, num_heads=1,
     table = (jnp.arange(b, dtype=jnp.int32)[:, None] * mb
              + jnp.arange(mb, dtype=jnp.int32)[None, :])
     return _paged_flash_call(q, as_pool(k_cache), as_pool(v_cache), table,
-                             total_len, num_heads, scale, interpret)
+                             total_len, num_heads, scale, interpret,
+                             num_kv_heads=num_kv_heads)
 
 
 # ---------------------------------------------------------------------------

@@ -62,7 +62,8 @@ RING_PATH = {"last": None}
 _INTERPRET_WARNED = {"done": False}
 
 
-def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
+def dense_attention(q, k, v, num_heads=1, causal=False, scale=None,
+                    num_kv_heads=0):
     """Single-device reference: the ``dot_product_attention`` op's own
     kernel (one copy of the numerics — ``ops.attention.sdpa``)."""
     import jax.numpy as jnp
@@ -70,12 +71,13 @@ def dense_attention(q, k, v, num_heads=1, causal=False, scale=None):
     from ..ops.attention import sdpa
 
     return sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
-                num_heads=num_heads, causal=causal, scale=scale)
+                num_heads=num_heads, causal=causal, scale=scale,
+                num_kv_heads=num_kv_heads)
 
 
 def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
                    scale=None, use_flash=None, interpret=None,
-                   head_axis=None, double_buffer=None):
+                   head_axis=None, double_buffer=None, num_kv_heads=0):
     """Blockwise ring attention over the ``axis_name`` mesh axis.
 
     Args are the LOCAL sequence blocks (B, T_local, E_local).  Device i
@@ -126,17 +128,32 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
 
     from .. import config as _config
 
+    from ..ops.attention import check_head_groups
+
     b, t_local, e = q.shape
     if head_axis is not None:
         # head-group sharding: axis sizes are static, so psum(1, axis)
-        # folds to a Python int and num_heads becomes the per-shard count
+        # folds to a Python int and num_heads becomes the per-shard count.
+        # Grouped K/V shard by the SAME axis at kv-head granularity, so
+        # both counts must divide — loud ValueErrors naming the dims, not
+        # a reshape trace error inside the shard_map region.
         head_par = lax.psum(1, head_axis)
-        assert num_heads % head_par == 0, \
-            "num_heads %d not divisible by %r axis size %d" \
-            % (num_heads, head_axis, head_par)
+        if num_heads % head_par != 0:
+            raise ValueError(
+                "ring_attention: num_heads=%d not divisible by %r axis "
+                "size %d" % (num_heads, head_axis, head_par))
+        kvh_global = int(num_kv_heads) or int(num_heads)
+        if kvh_global % head_par != 0:
+            raise ValueError(
+                "ring_attention: num_kv_heads=%d not divisible by %r "
+                "axis size %d" % (kvh_global, head_axis, head_par))
         num_heads //= head_par
+        num_kv_heads = kvh_global // head_par
+    num_kv_heads, group = check_head_groups(
+        num_heads, num_kv_heads, e, v.shape[2], k.shape[2],
+        where="ring_attention")
     hd = e // num_heads
-    ev = v.shape[2] // num_heads
+    ev = v.shape[2] // num_kv_heads
     scale = scale or 1.0 / np.sqrt(hd)
     if double_buffer is None:
         double_buffer = _config.get("MXNET_RING_DOUBLE_BUFFER")
@@ -168,19 +185,32 @@ def ring_attention(q, k, v, axis_name, num_heads=1, causal=False,
         from ..ops import pallas_attention as _pa
 
         use_flash = (jax.default_backend() == "tpu" and ev == hd
-                     and _pa.supported(q.shape, k.shape, causal, num_heads))
+                     and _pa.supported(q.shape, k.shape, causal, num_heads,
+                                       num_kv_heads=num_kv_heads))
     if use_flash:
         RING_PATH["last"] = "flash"
         return _ring_flash_fn(axis_name, bool(causal), float(scale),
                               bool(interpret), num_heads,
-                              bool(double_buffer))(q, k, v)
+                              bool(double_buffer),
+                              num_kv_heads)(q, k, v)
     RING_PATH["last"] = "streaming"
 
-    qh = q.reshape(b, t_local, num_heads, hd) * scale
-    kh = k.reshape(b, t_local, num_heads, hd)
-    vh = v.reshape(b, t_local, num_heads, ev)
-    out = _ring_stream(qh, kh, vh, axis_name, causal, double_buffer)
-    return out.astype(v.dtype).reshape(b, t_local, v.shape[2])
+    if group == 1:
+        # ungrouped path kept verbatim (G=1 bit-identity)
+        qh = q.reshape(b, t_local, num_heads, hd) * scale
+        kh = k.reshape(b, t_local, num_heads, hd)
+        vh = v.reshape(b, t_local, num_heads, ev)
+        out = _ring_stream(qh, kh, vh, axis_name, causal, double_buffer)
+        return out.astype(v.dtype).reshape(b, t_local, v.shape[2])
+    # grouped: only the (B, T_local, H_kv*hd) K/V blocks enter the ring —
+    # every ppermute moves G× fewer bytes (asserted by the hlo_stats
+    # collective-byte budget in tests/test_seq_parallel.py)
+    qh = q.reshape(b, t_local, num_kv_heads, group, hd) * scale
+    kh = k.reshape(b, t_local, num_kv_heads, hd)
+    vh = v.reshape(b, t_local, num_kv_heads, ev)
+    out = _ring_stream_grouped(qh, kh, vh, axis_name, causal,
+                               double_buffer)
+    return out.astype(v.dtype).reshape(b, t_local, num_heads * ev)
 
 
 def _ring_stream(qh, kh, vh, axis_name, causal, double_buffer):
@@ -252,11 +282,70 @@ def _ring_stream(qh, kh, vh, axis_name, causal, double_buffer):
     return acc / denom.transpose(0, 2, 1)[..., None]
 
 
+def _ring_stream_grouped(qh, kh, vh, axis_name, causal, double_buffer):
+    """Grouped-query twin of :func:`_ring_stream`: ``qh`` is head-split
+    (B, T_local, H_kv, G, hd) (pre-scaled), K/V stay at their physical
+    kv width (B, T_local, H_kv, hd/ev) — each ring hop rotates only the
+    H_kv-wide blocks and q-head (h, g) scores kv-head h inside the
+    einsum, never through a materialized broadcast.  Returns the
+    normalized (B, T_local, H_kv, G, ev) float32 output."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, kv_heads, group, _ = qh.shape
+    ev = vh.shape[3]
+
+    neg_inf = jnp.finfo(jnp.float32).min
+    m0 = jnp.full((b, kv_heads, group, t_local), neg_inf, jnp.float32)
+    l0 = jnp.zeros((b, kv_heads, group, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, t_local, kv_heads, group, ev), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def rotate(kb, vb):
+        return (lax.ppermute(kb, axis_name, perm),
+                lax.ppermute(vb, axis_name, perm))
+
+    def step(carry, r):
+        m, l, acc, kb, vb = carry
+        last = r == n - 1
+        nxt = rotate(kb, vb) if double_buffer and not last else None
+        src = (idx - r) % n
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qh,
+                            kb).astype(jnp.float32)
+        if causal:
+            iq = idx * t_local + jnp.arange(t_local)
+            ik = src * t_local + jnp.arange(t_local)
+            mask = iq[:, None] >= ik[None, :]
+            logits = jnp.where(mask[None, None, None], logits, neg_inf)
+        blk_m = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, blk_m)
+        safe_new_m = jnp.where(new_m == neg_inf, 0.0, new_m)
+        correction = jnp.where(m == neg_inf, 0.0, jnp.exp(m - safe_new_m))
+        p = jnp.exp(logits - safe_new_m[..., None])
+        p = jnp.where(logits == neg_inf, 0.0, p)
+        new_l = l * correction + p.sum(-1)
+        new_acc = acc * correction.transpose(0, 3, 1, 2)[..., None] + \
+            jnp.einsum("bhgqk,bkhe->bqhge", p, vb.astype(jnp.float32))
+        if not last:
+            kb, vb = rotate(kb, vb) if nxt is None else nxt
+        return (new_m, new_l, new_acc, kb, vb), None
+
+    carry = (m0, l0, acc0, kh, vh)
+    for r in range(n):
+        carry, _ = step(carry, r)
+    m, l, acc, _, _ = carry
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return acc / denom.transpose(0, 3, 1, 2)[..., None]
+
+
 _RING_FLASH_CACHE = {}
 
 
 def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
-                   double_buffer):
+                   double_buffer, num_kv_heads=0):
     """custom_vjp-wrapped flash ring: forward runs a ring of forward flash
     kernels whose per-block (out, lse) partials merge with logsumexp
     weights; backward runs a second ring of the backward kernels using the
@@ -275,8 +364,16 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
     one — the only dataflow ordering under which XLA can overlap the
     accumulator wire time.  Contribution r is still folded before
     rotation r+1 and rotated exactly n-r times, so serial and
-    double-buffered gradients are bit-identical."""
-    key = (axis_name, causal, scale, interpret, num_heads, double_buffer)
+    double-buffered gradients are bit-identical.
+
+    ``num_kv_heads`` < ``num_heads`` runs the grouped (GQA) ring: K/V
+    fold to (B*H_kv, T, hd) — so every ppermute (K/V forward, traveling
+    dK/dV backward) moves G× fewer bytes — and the hop kernels map
+    q-head ``h`` onto kv block ``h // G`` in their BlockSpec index maps
+    (``groups=`` in ``pa._fwd_call``/``_bwd_call``), accumulating dK/dV
+    at the grouped width in-kernel."""
+    key = (axis_name, causal, scale, interpret, num_heads, double_buffer,
+           num_kv_heads)
     hit = _RING_FLASH_CACHE.get(key)
     if hit is not None:
         return hit
@@ -286,6 +383,9 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
     from jax import lax
 
     from ..ops import pallas_attention as pa
+
+    kv_heads = int(num_kv_heads) or int(num_heads)
+    group = num_heads // kv_heads
 
     def fold(x, b, t, h, hd):
         return x.reshape(b, t, h, hd).transpose(0, 2, 1, 3) \
@@ -301,8 +401,8 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
         b, tl, e = q.shape
         hd = e // num_heads
         qf = fold(q, b, tl, num_heads, hd)
-        kb = fold(k, b, tl, num_heads, hd)
-        vb = fold(v, b, tl, num_heads, hd)
+        kb = fold(k, b, tl, kv_heads, hd)
+        vb = fold(v, b, tl, kv_heads, hd)
         bh = b * num_heads
         perm = [(i, (i + 1) % n) for i in range(n)]
         neg_inf = jnp.float32(-jnp.inf)
@@ -314,13 +414,13 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
         def full_blk(args):
             qq, kk, vv = args
             ob, lb = pa._fwd_call(qq, kk, vv, scale, False, interpret,
-                                  with_lse=True)
+                                  with_lse=True, groups=group)
             return ob.astype(jnp.float32), lb[:, :, 0]
 
         def diag_blk(args):
             qq, kk, vv = args
             ob, lb = pa._fwd_call(qq, kk, vv, scale, True, interpret,
-                                  with_lse=True)
+                                  with_lse=True, groups=group)
             return ob.astype(jnp.float32), lb[:, :, 0]
 
         def skip_blk(args):
@@ -376,8 +476,8 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
         hd = e // num_heads
         bh = b * num_heads
         qf = fold(q, b, tl, num_heads, hd)
-        kb = fold(k, b, tl, num_heads, hd)
-        vb = fold(v, b, tl, num_heads, hd)
+        kb = fold(k, b, tl, kv_heads, hd)
+        vb = fold(v, b, tl, kv_heads, hd)
         dof = fold(do, b, tl, num_heads, hd)
         ofd = of.astype(qf.dtype)  # _bwd_call recomputes delta from do*o
         lse3 = jnp.broadcast_to(lse[..., None], (bh, tl, pa.LANES))
@@ -390,20 +490,23 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
         def full_blk(args):
             qq, kk, vv = args
             dq_b, dk_b, dv_b = pa._bwd_call(qq, kk, vv, ofd, lse3, dof,
-                                            scale, False, interpret)
+                                            scale, False, interpret,
+                                            groups=group)
             return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
                     dv_b.astype(jnp.float32))
 
         def diag_blk(args):
             qq, kk, vv = args
             dq_b, dk_b, dv_b = pa._bwd_call(qq, kk, vv, ofd, lse3, dof,
-                                            scale, True, interpret)
+                                            scale, True, interpret,
+                                            groups=group)
             return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
                     dv_b.astype(jnp.float32))
 
         def skip_blk(args):
-            z = jnp.zeros((bh, tl, hd), jnp.float32)
-            return z, z, z
+            zq = jnp.zeros((bh, tl, hd), jnp.float32)
+            zkv = jnp.zeros((b * kv_heads, tl, hd), jnp.float32)
+            return zq, zkv, zkv
 
         def hop(r):
             src = (idx - r) % n
@@ -414,8 +517,11 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
             return full_blk((qf, kb, vb))
 
         dq = jnp.zeros((bh, tl, hd), jnp.float32)
-        dkb = jnp.zeros((bh, tl, hd), jnp.float32)
-        dvb = jnp.zeros((bh, tl, hd), jnp.float32)
+        # traveling dK/dV accumulate at the GROUPED width — together with
+        # the folded kb/vb above, every backward-ring ppermute is G×
+        # smaller than the MHA ring's
+        dkb = jnp.zeros((b * kv_heads, tl, hd), jnp.float32)
+        dvb = jnp.zeros((b * kv_heads, tl, hd), jnp.float32)
         if double_buffer:
             # gradient accumulators travel WITH their K/V blocks, but hop
             # r's contribution need not leave until rotation r+1 — so fold
@@ -453,8 +559,8 @@ def _ring_flash_fn(axis_name, causal, scale, interpret, num_heads,
                 if not last:
                     kb, vb = rotate(kb, vb)
         dq_out = unfold(dq, b, tl, num_heads, hd).astype(q.dtype)
-        dk_out = unfold(dkb, b, tl, num_heads, hd).astype(k.dtype)
-        dv_out = unfold(dvb, b, tl, num_heads, hd).astype(v.dtype)
+        dk_out = unfold(dkb, b, tl, kv_heads, hd).astype(k.dtype)
+        dv_out = unfold(dvb, b, tl, kv_heads, hd).astype(v.dtype)
         return dq_out, dk_out, dv_out
 
     rf.defvjp(rf_fwd, rf_bwd)

@@ -46,8 +46,37 @@ ELEMENTWISE_OPS = {
 }
 
 
-def kv_cache_pspec(mesh_shape, batch_axis="data", head_axis="model"):
-    """PartitionSpec for a (B, C, E) decode KV cache on a mesh.
+def _kv_head_axis(sizes, head_axis, num_kv_heads, what):
+    """The trailing-dim mesh axis for a K/V cache/pool, kv-head aware.
+
+    MHA (``num_kv_heads`` None/0) keeps the unconditional E-split.  A
+    grouped layout's trailing dim is H_kv head slices, so the E-split IS
+    an H_kv-split: legal only when ``num_kv_heads % axis_size == 0``.
+    Otherwise degrade VISIBLY to replicated-group sharding (every model
+    shard holds all H_kv kv heads; q heads still split) with a warning —
+    wrong-but-silent sharding of a grouped pool would interleave kv-head
+    slices across shards and score q-heads against the wrong group.
+    """
+    size = sizes.get(head_axis, 1)
+    if size <= 1:
+        return None
+    if num_kv_heads:
+        kvh = int(num_kv_heads)
+        if kvh % size:
+            import warnings
+
+            warnings.warn(
+                "%s: num_kv_heads=%d not divisible by %r axis size %d — "
+                "degrading to replicated-group sharding (each model shard "
+                "holds the full grouped K/V)" % (what, kvh, head_axis,
+                                                 size))
+            return None
+    return head_axis
+
+
+def kv_cache_pspec(mesh_shape, batch_axis="data", head_axis="model",
+                   num_kv_heads=None):
+    """PartitionSpec for a (B, C, E_kv) decode KV cache on a mesh.
 
     The Megatron invariant this module's plan rests on — an E-split IS a
     head-group split (heads are contiguous hd-wide slices of E) — carries
@@ -57,16 +86,21 @@ def kv_cache_pspec(mesh_shape, batch_axis="data", head_axis="model"):
     et al. inference sharding).  The ring-slot dim stays replicated
     (appends index it dynamically); the batch dim shards on ``batch_axis``
     so serving slots spread over the data axis.  Axes of size 1 drop out.
+
+    ``num_kv_heads`` (grouped-query caches) gates the trailing split on
+    ``num_kv_heads % axis == 0``; otherwise the kv dim degrades visibly
+    to replicated (see :func:`_kv_head_axis`).
     """
     from jax.sharding import PartitionSpec as P
 
     sizes = dict(mesh_shape)
     return P(batch_axis if sizes.get(batch_axis, 1) > 1 else None, None,
-             head_axis if sizes.get(head_axis, 1) > 1 else None)
+             _kv_head_axis(sizes, head_axis, num_kv_heads,
+                           "kv_cache_pspec"))
 
 
-def kv_pool_pspec(mesh_shape, head_axis="model"):
-    """PartitionSpec for a (P, page_tokens, E) paged KV pool on a mesh.
+def kv_pool_pspec(mesh_shape, head_axis="model", num_kv_heads=None):
+    """PartitionSpec for a (P, page_tokens, E_kv) paged KV pool on a mesh.
 
     Same Megatron invariant as :func:`kv_cache_pspec` — the trailing E dim
     shards on ``head_axis`` so each model shard holds and scores only its
@@ -74,13 +108,15 @@ def kv_pool_pspec(mesh_shape, head_axis="model"):
     are a GLOBAL id space shared by every serving slot (batch never enters
     the pool's shape — slots meet the pool through their page tables), so
     there is no batch axis to spread, and the page-id gathers/scatters
-    stay local per shard.  Axes of size 1 drop out.
+    stay local per shard.  Axes of size 1 drop out.  ``num_kv_heads``
+    behaves as in :func:`kv_cache_pspec`.
     """
     from jax.sharding import PartitionSpec as P
 
     sizes = dict(mesh_shape)
     return P(None, None,
-             head_axis if sizes.get(head_axis, 1) > 1 else None)
+             _kv_head_axis(sizes, head_axis, num_kv_heads,
+                           "kv_pool_pspec"))
 
 
 def plan_tensor_parallel(symbol):

@@ -207,7 +207,7 @@ class PrefillWorker:
             prompt, [first], list(prompt) + [first], cap, priority,
             lens=prompt.size, tok=first, row_valid=row != 0, data=data,
             kind="migrate", publish=True, submit_ts=submit_ts,
-            first_ts=time.time())
+            first_ts=time.time(), kv_heads=pred._grouped_kv_heads)
         mgr.free_slot(0)
         self.prefills += 1
         return record

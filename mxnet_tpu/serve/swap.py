@@ -39,15 +39,21 @@ class SwappedRequest:
     the (M,) mask of mapped ring positions; ``lens``/``tok`` the
     committed length and pending token; ``delivered`` the tokens already
     emitted to the caller (generation resumes counting toward ``cap``).
+
+    ``kv_heads`` records the grouped-query K/V layout of the saved pages
+    (None = MHA): a GQA source's page planes are H_kv head slices wide —
+    G× fewer bytes on the swap/migration wire — and the readmitting host
+    must run the SAME grouped layout (checked at install; raw pool bytes
+    carry no head structure of their own).
     """
 
     __slots__ = ("prompt", "delivered", "history", "cap", "priority",
                  "lens", "tok", "row_valid", "data", "kind", "publish",
-                 "submit_ts", "first_ts", "rid")
+                 "submit_ts", "first_ts", "rid", "kv_heads")
 
     def __init__(self, prompt, delivered, history, cap, priority, lens,
                  tok, row_valid, data, kind="swap", publish=False,
-                 submit_ts=None, first_ts=None, rid=None):
+                 submit_ts=None, first_ts=None, rid=None, kv_heads=None):
         self.prompt = np.asarray(prompt).reshape(-1).astype(np.int64)
         self.delivered = list(delivered)
         self.history = list(history)
@@ -62,6 +68,7 @@ class SwappedRequest:
         self.submit_ts = submit_ts
         self.first_ts = first_ts
         self.rid = rid              # the router-/host-level id it keeps
+        self.kv_heads = int(kv_heads) if kv_heads else None
 
     @property
     def n_pages(self):

@@ -38,7 +38,12 @@ acceptance lines are asserted by the bench itself at full dims; the
 bench asserts the noise-free paged halves at every dims (token identity
 vs the dense-ring drain, zero retraces, hit rate > 0) and the smoke pins
 them again from the JSON, only REPORTING wall-clock ratios, because this
-harness's wall clock is shared-machine noise.
+harness's wall clock is shared-machine noise.  The GQA phase rides the
+same split: the bench asserts the exact G x pool shrink, G=1 token
+identity and zero retraces itself; the smoke re-pins the deterministic
+grouped-KV halves (pool ratio exactly 1/G, grouped attention bytes
+under the MHA price, int8 compounding under the grouping ratio) from
+the JSON.
 """
 import json
 import os
@@ -244,13 +249,43 @@ def test_bench_decode_smoke_contract():
     assert head["decode_attn_bytes_per_token"] == expect, head
     assert head["decode_attn_bytes_ratio"] > 1.0, head
 
+    # --- the GQA/MQA grouped-KV contract ---
+    # deterministic halves only (the bench itself asserts the exact G x
+    # pool shrink, G=1 token identity vs the MHA paged drain and zero
+    # retraces, exiting nonzero): every K/V plane is physically 1/G the
+    # MHA pool, the statically-priced grouped decode attention bytes
+    # undercut the MHA price, and int8 quantization compounds with
+    # grouping against the f32 MHA pool.  The <= 0.3x / <= 0.35x /
+    # <= 0.1x acceptance lines are asserted by the bench's own
+    # full-dims (T=2048, G >= 4) run; the capacity wall-clock ratio is
+    # REPORTED only (shared-machine noise).
+    assert head["gqa_group"] > 1, head
+    assert head["gqa_groups"][-1] == head["gqa_group"], head
+    assert head["gqa_num_kv_heads"] * head["gqa_group"] == 4, head
+    assert head["gqa_cache_bytes_per_slot"] > 0, head
+    assert abs(head["gqa_pool_ratio_vs_mha"] * head["gqa_group"] - 1.0) \
+        < 1e-6, head
+    assert head["gqa_pool_bytes"] * head["gqa_group"] == \
+        head["pool_bytes"], head
+    assert head["gqa_decode_attn_bytes_per_token"] < \
+        head["decode_attn_bytes_per_token"], head
+    assert head["gqa_int8_vs_f32_mha_pool_ratio"] < \
+        head["gqa_pool_ratio_vs_mha"], head
+    assert head["mha_pool_bytes_f32"] > head["pool_bytes"], head
+    assert head["vs_mha_tokens_per_sec_per_gb"] > 0, head
+    assert head["gqa_tokens_per_sec"] > 0, head
+
     # stderr: one JSON per phase, all phases present
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     phases = {r.get("phase") for r in rows}
     assert {"flops", "prefill", "decode", "naive", "serve",
-            "serve_spec_quant", "serve_paged", "pallas_decode"} <= phases, \
-        phases
+            "serve_spec_quant", "serve_paged", "pallas_decode",
+            "gqa"} <= phases, phases
+    gqa_rows = {r["groups"]: r for r in rows
+                if r.get("phase") == "gqa" and "groups" in r}
+    assert set(gqa_rows) == set(head["gqa_groups"]), sorted(gqa_rows)
+    assert gqa_rows[1]["pool_ratio_vs_mha"] == 1.0, gqa_rows[1]
     spec_row = next(r for r in rows if r.get("phase") == "serve_spec_quant")
     dense_row = next(r for r in rows if r.get("phase") == "serve")
     assert spec_row["spec_steps"] > 0
@@ -492,11 +527,13 @@ def test_mxstat_smoke_contract():
 
 
 def test_mxlint_smoke_contract():
-    """`tools/mxlint.py --smoke` must audit all twelve canonical programs
-    (the speculative trio — draft_step / verify_step / decode_step_q —
-    driven by a real mixed-length speculative serve; the paged pair —
-    paged_decode_step / paged_verify_step — by a real shared-prefix
-    paged serve with chunked prefill, COW forks and retirements;
+    """`tools/mxlint.py --smoke` must audit all thirteen canonical
+    programs (the speculative trio — draft_step / verify_step /
+    decode_step_q — driven by a real mixed-length speculative serve;
+    the paged pair — paged_decode_step / paged_verify_step — by a real
+    shared-prefix paged serve with chunked prefill, COW forks and
+    retirements; gqa_decode_step by a grouped-query paged serve whose
+    K/V pool is physically G× narrower than its query width;
     ckpt_train_step by a real fit under async fenced checkpointing;
     moe_train_step by a real top-2 capacity-routed MoE LM step whose
     explicit all-to-all dispatch the collective pass budgets) with
@@ -529,14 +566,14 @@ def test_mxlint_smoke_contract():
     assert head["errors"] == 0 and head["warnings"] == 0, head
     # every canonical program was built (the virtual mesh gives ring×TP
     # and the expert-parallel MoE step)
-    assert head["programs"] == 12 and head["passes"] == 7, head
+    assert head["programs"] == 13 and head["passes"] == 7, head
     assert head["skipped_programs"] == [], head
 
     # stderr: one JSON finding per line; every (pass, program) pair ran
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     pairs = {(r["pass"], r["program"]) for r in rows if "pass" in r}
-    assert len(pairs) == 84, sorted(pairs)
+    assert len(pairs) == 91, sorted(pairs)
     # the expert-parallel step's committed all-to-all ceiling is live:
     # the collective pass measured real exchanges within budget
     a2a_row = next(r for r in rows

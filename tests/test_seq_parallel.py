@@ -728,9 +728,9 @@ def test_ring_dispatch_rejects_malformed_head_configs():
                     is_train=False)
         return mod
 
-    # embed dim not divisible by heads: the einsum kernel's assert, not a
+    # embed dim not divisible by heads: the named head-group guard, not a
     # shard_map reshape trace error
-    with pytest.raises(AssertionError, match="divisible by num_heads"):
+    with pytest.raises(ValueError, match="not divisible by num_heads"):
         build(e=10, heads=3, mesh_config=MeshConfig(data=2, seq=4))
 
     # heads not divisible by the model axis: einsum fallback

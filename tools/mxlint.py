@@ -2,7 +2,7 @@
 """mxlint — static program-analysis lint over the framework's canonical
 compiled programs.
 
-Builds the twelve canonical programs on the current backend (``--smoke``
+Builds the thirteen canonical programs on the current backend (``--smoke``
 forces the 8-virtual-device CPU platform so the ring×TP and
 expert-parallel MoE mesh programs exist on one box; the speculative
 trio — draft_step / verify_step / decode_step_q — is driven by a real

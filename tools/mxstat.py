@@ -125,6 +125,10 @@ def _render_diff_table(rows):
 _EXTRA_SUFFIXES = (".ratio", ".count", "_ms", "_rate", "_pages",
                    "_outs", "_prefills", "_tokens_per_sec",
                    "vs_round_robin",
+                   # capacity headlines and the GQA contract
+                   # (bench_decode.py): tokens/s/GB and the grouped-KV
+                   # ratios; the gqa_*bytes* fields match the byte rule
+                   "_per_gb", "_vs_mha", "gqa_group",
                    # the bench_fleet.py --cold-start contract: per-host
                    # program readiness, warm AOT cache vs trace+compile
                    "cold_start_s", "cold_start_jit_s", "cold_start_vs_jit",
