@@ -13,8 +13,12 @@ cache-key drift fails CI instead of waiting for the TPU rig:
   canonical program's jaxpr/StableHLO/HLO surfaces + metadata;
 * :mod:`~mxnet_tpu.analysis.framework` — :class:`Pass`,
   :class:`Finding`, suppression matching and :func:`run_passes`;
-* :mod:`~mxnet_tpu.analysis.passes` — the five shipped passes (donation,
-  collective budget, retrace, host sync, FLOP/dtype);
+* :mod:`~mxnet_tpu.analysis.passes` — the shipped passes (donation,
+  collective budget, retrace, host sync, FLOP/dtype, cache bytes, tuner
+  coverage, sharding coverage, drift) plus the drift-snapshot
+  record/hash helpers;
+* :mod:`~mxnet_tpu.analysis.schedule` — the compiled-HLO schedule model
+  (async start/done pairing + compute shadows) and the schedule pass;
 * :mod:`~mxnet_tpu.analysis.retrace` — :class:`RetraceAuditor` for
   instrumenting arbitrary jitted functions;
 * :mod:`~mxnet_tpu.analysis.programs` — builders for the five canonical
@@ -34,19 +38,26 @@ import json
 import os
 
 from .artifact import ProgramArtifact, artifact_from_jit
-from .cost import aval_bytes, program_cost
+from .cost import artifact_cost, aval_bytes, program_cost
 from .framework import (Finding, Pass, Report, SEVERITIES, default_passes,
                         run_passes)
 from .passes import (CacheBytesPass, CollectiveBudgetPass, DonationPass,
-                     FlopDtypePass, HostSyncPass, RetracePass)
+                     DriftPass, FlopDtypePass, HostSyncPass, RetracePass,
+                     ShardingCoveragePass, TunerCoveragePass,
+                     record_snapshot, snapshot_hash)
 from .retrace import RetraceAuditor, arg_signature, signature_diff
+from .schedule import ScheduleModel, SchedulePass, parse_schedule
 
 __all__ = [
-    "CacheBytesPass", "CollectiveBudgetPass", "DonationPass", "Finding",
-    "FlopDtypePass", "HostSyncPass", "Pass", "ProgramArtifact", "Report",
-    "RetraceAuditor", "RetracePass", "SEVERITIES", "arg_signature",
+    "CacheBytesPass", "CollectiveBudgetPass", "DonationPass", "DriftPass",
+    "Finding", "FlopDtypePass", "HostSyncPass", "Pass", "ProgramArtifact",
+    "Report", "RetraceAuditor", "RetracePass", "SEVERITIES",
+    "ScheduleModel", "SchedulePass", "ShardingCoveragePass",
+    "TunerCoveragePass", "arg_signature", "artifact_cost",
     "artifact_from_jit", "aval_bytes", "default_passes", "load_budgets",
-    "program_cost", "resolve_budgets_path", "run_passes", "signature_diff",
+    "load_snapshot", "parse_schedule", "program_cost", "record_snapshot",
+    "resolve_budgets_path", "run_passes", "signature_diff",
+    "snapshot_hash",
 ]
 
 _DEFAULT_BUDGETS = os.path.join(
@@ -77,3 +88,25 @@ def load_budgets(path=None):
         return {}
     with open(path) as f:
         return json.load(f)
+
+
+def load_snapshot(path):
+    """Parse a drift snapshot (``mxlint --record`` output) and verify
+    its content hash.
+
+    A mismatch raises ``ValueError``: the baseline was hand-edited, and
+    a gate whose baseline can be quietly nudged is no gate — intentional
+    changes re-record through the tool.
+    """
+    from .passes import snapshot_hash
+
+    with open(path) as f:
+        snap = json.load(f)
+    want = snap.get("content_hash")
+    have = snapshot_hash(snap)
+    if want != have:
+        raise ValueError(
+            "drift snapshot %s content hash mismatch (recorded %s, "
+            "computed %s) — the file was edited by hand; re-record it "
+            "with tools/mxlint.py --record" % (path, want, have))
+    return snap

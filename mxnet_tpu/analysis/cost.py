@@ -26,7 +26,7 @@ device work — and runs at table time, never on a hot path.
 """
 from __future__ import annotations
 
-__all__ = ["aval_bytes", "program_cost"]
+__all__ = ["artifact_cost", "aval_bytes", "program_cost"]
 
 
 def aval_bytes(tree):
@@ -82,3 +82,34 @@ def program_cost(fn, args):
             "collective_bytes": int(coll),
             "gather_bytes": int(gath),
             "sort_scatter_bytes": int(srtsc)}
+
+
+def artifact_cost(artifact):
+    """Priced quantities of a BUILT artifact — one drift-snapshot row.
+
+    Unlike :func:`program_cost` this needs no callable: everything is
+    re-derived from the artifact's recorded text surfaces and metadata,
+    so the drift gate (``analysis.passes.DriftPass`` + ``mxlint
+    --record/--check``) compares exactly what the other passes audit.
+    Quantities from a missing surface are simply absent — the pass
+    reports the asymmetry instead of guessing zero."""
+    from .hlo_parse import (collective_stats, dot_flops,
+                            input_output_aliases, stablehlo_gather_stats,
+                            stablehlo_sort_scatter_stats)
+
+    row = {"donated": int(artifact.donated_leaves or 0)}
+    if artifact.stablehlo_text is not None:
+        row["dot_flops"] = int(dot_flops(artifact.stablehlo_text))
+        row["gather_bytes"] = int(
+            stablehlo_gather_stats(artifact.stablehlo_text)["bytes"])
+        row["sort_scatter_bytes"] = int(stablehlo_sort_scatter_stats(
+            artifact.stablehlo_text)["total"]["bytes"])
+    if artifact.compiled_text is not None:
+        stats = collective_stats(artifact.compiled_text)
+        row["collective_count"] = int(stats["total"]["count"])
+        row["collective_bytes"] = int(stats["total"]["bytes"])
+        row["aliased"] = len({param for _, param in
+                              input_output_aliases(artifact.compiled_text)})
+    if artifact.meta.get("cache_bytes") is not None:
+        row["cache_bytes"] = int(artifact.meta["cache_bytes"])
+    return row

@@ -87,6 +87,9 @@ class AnalysisContext:
     """Shared state the driver hands every pass."""
 
     budgets: dict = field(default_factory=dict)
+    # parsed drift snapshot (``mxlint --check``); None = drift pass
+    # reports "no snapshot loaded" info rows instead of comparing
+    snapshot: dict = None
 
     def budget_for(self, program):
         return self.budgets.get("programs", {}).get(program)
@@ -181,38 +184,51 @@ def _is_suppressed(finding, triples):
 
 
 def default_passes():
-    """Fresh instances of the seven shipped passes, in run order."""
+    """Fresh instances of the ten shipped passes, in run order."""
     from .passes import (CacheBytesPass, CollectiveBudgetPass, DonationPass,
-                         FlopDtypePass, HostSyncPass, RetracePass,
+                         DriftPass, FlopDtypePass, HostSyncPass,
+                         RetracePass, ShardingCoveragePass,
                          TunerCoveragePass)
+    from .schedule import SchedulePass
 
     return [DonationPass(), CollectiveBudgetPass(), RetracePass(),
             HostSyncPass(), FlopDtypePass(), CacheBytesPass(),
-            TunerCoveragePass()]
+            TunerCoveragePass(), SchedulePass(), ShardingCoveragePass(),
+            DriftPass()]
 
 
 _SURFACE_ATTR = {"jaxpr": "jaxpr_text", "stablehlo": "stablehlo_text",
                  "compiled": "compiled_text"}
 
 
-def run_passes(artifacts, passes=None, budgets=None, suppressions=None):
+def run_passes(artifacts, passes=None, budgets=None, suppressions=None,
+               snapshot=None):
     """Drive ``passes`` (default: all shipped passes) over
     ``artifacts`` and return a :class:`Report`.
 
     ``budgets`` is the parsed budget file (``benchmarks/budgets.json``
     layout); its ``suppressions`` list, the ``MXNET_ANALYSIS_SUPPRESS``
-    env var, and the ``suppressions`` argument all apply.
+    env var, and the ``suppressions`` argument all apply.  ``snapshot``
+    is a parsed drift snapshot (``mxlint --check``) handed to the drift
+    pass through the context.
+
+    A budget-file suppression that matches NO finding of the run emits
+    a ``stale-suppression`` info row (pass name ``suppressions``): the
+    waived issue stopped firing, so the waiver is dead weight that
+    would silently swallow the next regression of the same shape.
+    Env/argument suppressions are session-local and exempt.
     """
     from .. import config as _config
 
     if passes is None:
         passes = default_passes()
     budgets = budgets or {}
-    triples = _parse_suppressions(budgets.get("suppressions"))
+    budget_triples = _parse_suppressions(budgets.get("suppressions"))
+    triples = list(budget_triples)
     triples += _parse_suppressions(_config.get("MXNET_ANALYSIS_SUPPRESS"))
     triples += _parse_suppressions(suppressions)
 
-    context = AnalysisContext(budgets=budgets)
+    context = AnalysisContext(budgets=budgets, snapshot=snapshot)
     findings = []
     for artifact in artifacts:
         for p in passes:
@@ -227,5 +243,16 @@ def run_passes(artifacts, passes=None, budgets=None, suppressions=None):
             findings.extend(p.run(artifact, context))
     for f in findings:
         f.suppressed = _is_suppressed(f, triples)
+    for triple in budget_triples:
+        if any(_is_suppressed(f, [triple]) for f in findings):
+            continue
+        stale = Finding(
+            pass_name="suppressions", program="*", severity="info",
+            message="budget-file suppression %r matched no finding this "
+            "run — the waived issue stopped firing; remove it from the "
+            "budget file's suppressions list" % ":".join(triple),
+            code="stale-suppression", detail={"pattern": list(triple)})
+        stale.suppressed = _is_suppressed(stale, triples)
+        findings.append(stale)
     return Report(findings, programs=[a.name for a in artifacts],
                   passes=[p.name for p in passes])

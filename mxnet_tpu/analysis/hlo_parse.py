@@ -237,12 +237,26 @@ _HLO_CONV_RE = re.compile(
     r"=\s*([a-z][a-z0-9]+\[[0-9,]*\])\S*\s+convolution\("
     r"[^(]*?,\s*([a-z][a-z0-9]+\[[0-9,]*\])"
     r".*?dim_labels=[a-z0-9]+_([a-z0-9]+)->")
+# label-less convolution fallbacks: the shapes alone, for lines whose
+# dim_labels/dim_numbers metadata was stripped (debug dumps, minimized
+# repros).  dim-role parsing stays the PREFERRED path — these only match
+# after it fails, and the contraction is inferred from the conventional
+# kernel layout (HLO 'oi01': output features FIRST; StableHLO
+# '[0, 1, i, o]': output features LAST), cross-checked against the
+# result shape before counting.
+_HLO_CONV_NOLABEL_RE = re.compile(
+    r"=\s*([a-z][a-z0-9]+\[[0-9,]*\])\S*\s+convolution\("
+    r"[^(]*?,\s*([a-z][a-z0-9]+\[[0-9,]*\])")
+_SH_CONV_NOLABEL_RE = re.compile(
+    r"stablehlo\.convolution\b"
+    r".*?:\s*\(tensor<([^>]+)>\s*,\s*tensor<([^>]+)>\s*\)"
+    r"\s*->\s*tensor<([^>]+)>")
 
 # dot-like ops the counter knows it does NOT model: any appearance goes to
 # the report's uncounted_ops so a program using them cannot silently read
 # as zero FLOPs.  HLO 'dot(' lines missing contracting-dims metadata,
-# label-less convolutions and unparseable stablehlo dot forms are appended
-# dynamically.
+# convolutions whose shapes defeat even the label-less fallback, and
+# unparseable stablehlo dot forms are appended dynamically.
 _UNCOUNTED_RE = re.compile(
     r"(stablehlo\.convolution\b"
     r"|(?<![-\w])convolution\("
@@ -304,6 +318,28 @@ def _conv_contraction(rhs_dims, rhs_spec):
     return contraction
 
 
+def _conv_contraction_from_shapes(rhs_dims, out_dims, o_first):
+    """Per-output-element multiply count of a LABEL-LESS convolution,
+    inferred from the kernel and result shapes alone: contraction =
+    prod(kernel dims) / output-feature dim.  The output-feature dim is
+    taken from the conventional kernel layout of the dialect
+    (``o_first`` True for HLO's ``oi01``, False for StableHLO's
+    ``[0, 1, i, o]``), cross-checked against the result shape — a
+    candidate ``o`` absent from the result dims falls back to the other
+    end, and None (-> uncounted) when neither lines up.  Exact when the
+    layout convention holds; a floor (never an overcount of the honest
+    per-element work) otherwise, since every kernel element multiplies
+    at most once per output element."""
+    if not rhs_dims or not out_dims:
+        return None
+    ends = (0, -1) if o_first else (-1, 0)
+    for end in ends:
+        o = rhs_dims[end]
+        if o in out_dims:
+            return _prod(rhs_dims) // o
+    return None
+
+
 def dot_flops_report(program_text):
     """Structured matmul-FLOP accounting of a lowered program.
 
@@ -319,10 +355,17 @@ def dot_flops_report(program_text):
       (result element type), "flops", "line"}`` — the dtype-lint pass
       reads these to flag f32 dots inside bf16 programs;
     * ``uncounted_ops`` — dot-like ops the counter saw but could not
-      model (label-less convolutions, malformed dot lines), as
-      ``{"op", "count"}`` aggregates.  A non-empty list means ``flops``
-      is a floor, not a total — the FLOP-coverage pass turns it into an
-      error.
+      model (malformed dot lines, convolutions whose shapes defeat even
+      the label-less fallback), as ``{"op", "count"}`` aggregates.  A
+      non-empty list means ``flops`` is a floor, not a total — the
+      FLOP-coverage pass turns it into an error.
+
+    Convolutions parse through dim-role metadata first
+    (``dim_numbers``/``dim_labels``); a LABEL-LESS conv falls back to
+    shape inference (:func:`_conv_contraction_from_shapes` — contraction
+    = prod(kernel dims) / output-feature dim under the dialect's
+    conventional kernel layout) and its dot record carries
+    ``"inferred": True`` so audits can tell exact from inferred counts.
     """
     total = 0
     dots = []
@@ -390,6 +433,37 @@ def dot_flops_report(program_text):
                 dots.append({"op": "convolution",
                              "dtype": _bracket_dtype(m.group(1)),
                              "flops": flops, "line": line.strip()})
+                continue
+        # label-less fallbacks: contraction from operand/result shapes
+        # when the dim-role metadata is absent or unparsable (the
+        # preferred labeled paths above already failed on this line)
+        m = _SH_CONV_NOLABEL_RE.search(line)
+        if m is not None and "stablehlo.convolution" in line:
+            contraction = _conv_contraction_from_shapes(
+                _tensor_dims(m.group(2)), _tensor_dims(m.group(3)),
+                o_first=False)
+            if contraction is not None:
+                out = _tensor_dims(m.group(3))
+                flops = 2 * _prod(out) * contraction
+                total += flops
+                dots.append({"op": "stablehlo.convolution",
+                             "dtype": _tensor_dtype(m.group(3)),
+                             "flops": flops, "inferred": True,
+                             "line": line.strip()})
+                continue
+        m = _HLO_CONV_NOLABEL_RE.search(line)
+        if m is not None:
+            contraction = _conv_contraction_from_shapes(
+                _bracket_dims(m.group(2)), _bracket_dims(m.group(1)),
+                o_first=True)
+            if contraction is not None:
+                out = _bracket_dims(m.group(1))
+                flops = 2 * _prod(out) * contraction
+                total += flops
+                dots.append({"op": "convolution",
+                             "dtype": _bracket_dtype(m.group(1)),
+                             "flops": flops, "inferred": True,
+                             "line": line.strip()})
                 continue
         m = _UNCOUNTED_RE.search(line)
         if m is not None:

@@ -1,4 +1,4 @@
-"""The six shipped analysis passes.
+"""The shipped analysis passes (ten with ``schedule.SchedulePass``).
 
 Each pass statically audits one performance invariant the framework's PRs
 established, so a sharding-rule edit or a jit cache-key drift fails CI on
@@ -30,6 +30,21 @@ the 8-virtual-device CPU mesh instead of silently regressing a headline:
   (:mod:`mxnet_tpu.ops.tuning`): a new hardcoded ``BLOCK_*`` that never
   joined its module's tunable space is a shape the tuning cache can
   never improve — exactly the silent plateau ISSUE-16 closes.
+* :class:`ShardingCoveragePass` — partition-rule coverage over the bound
+  param tree (``meta['sharding_coverage']``): every leaf resolves to a
+  rule match or an *intentional* replicate; the placement degrade paths
+  (rank mismatch / indivisible dims in ``programs/partition.py`` and the
+  executor's TP rules) are errors naming the param, and the grouped-K/V
+  degrade (``tp_rules._kv_head_axis``, ``meta['replicated_degrades']``)
+  lints as a visible info row instead of a 4x HBM surprise.
+* :class:`DriftPass` — the differential gate: each program's priced
+  quantities (:func:`~mxnet_tpu.analysis.cost.artifact_cost`) compared
+  against a content-addressed snapshot (``mxlint --record/--check``)
+  within tolerance, so a PR that regresses dot FLOPs / collective bytes
+  / cache bytes / donation without re-recording fails tier-1.
+
+:class:`~mxnet_tpu.analysis.schedule.SchedulePass` (async-overlap
+shadows) lives in :mod:`~mxnet_tpu.analysis.schedule` with its parser.
 """
 from __future__ import annotations
 
@@ -39,7 +54,8 @@ from .hlo_parse import (collective_stats, dot_flops_report,
 
 __all__ = ["DonationPass", "CollectiveBudgetPass", "RetracePass",
            "HostSyncPass", "FlopDtypePass", "CacheBytesPass",
-           "TunerCoveragePass"]
+           "TunerCoveragePass", "ShardingCoveragePass", "DriftPass",
+           "record_snapshot", "snapshot_hash"]
 
 
 class DonationPass(Pass):
@@ -610,4 +626,278 @@ class TunerCoveragePass(Pass):
                 "registered with the autotuner"
                 % (total, len([1 for n in self._scan().values() if n])),
                 code="covered", constants=total))
+        return findings
+
+
+class ShardingCoveragePass(Pass):
+    """Partition-rule coverage over the bound param tree.
+
+    Mesh-bound programs stamp ``meta['sharding_coverage']`` — per-leaf
+    records written at placement time by ``programs/partition.
+    build_shardings`` (decode) and ``module/executor_group.
+    _param_sharding`` (train)::
+
+        {"mesh": {"data": 2, "model": 2},
+         "leaves": {"<param>": {"shape": [...],
+                                "source": "rule|plan|mesh_axes|naive|"
+                                          "default|scalar",
+                                "spec": [...],        # when sharded
+                                "degrade": "rank-mismatch|indivisible"}}}
+
+    Findings:
+
+    * a leaf a rule/plan MATCHED but the divisibility guard silently
+      replicated (``degrade``) is an **error naming the param** — the
+      intended placement was lost, every shard now holds the whole
+      tensor (the 4x-HBM surprise this pass exists to catch);
+    * the grouped-K/V cache degrade (``meta['replicated_degrades']``
+      from ``tp_rules._kv_head_axis`` — ``H_kv % model != 0``) is a
+      visible *info* row: legitimate, but never silent;
+    * an UNMATCHED >=2-D leaf replicating by default is an **error**
+      when the program's budget opts into strict coverage
+      (``{"sharding": {"strict": true}}``) and a visible *info*
+      otherwise — scalars and 1-D per-feature vectors always count as
+      intentional replicates.
+
+    Programs without a mesh (or predating the stamping) skip with an
+    info row.
+    """
+
+    name = "sharding-coverage"
+    requires = ()
+
+    def run(self, artifact, context):
+        findings = []
+        for rec in artifact.meta.get("replicated_degrades") or []:
+            findings.append(self.finding(
+                artifact, "info",
+                "%s degraded to replicated K/V sharding: %s — each "
+                "model shard holds the full grouped K/V (visible "
+                "degrade, see parallel/tp_rules._kv_head_axis)"
+                % (rec.get("site", "kv sharding"),
+                   rec.get("reason", "?")),
+                code="kv-replicated-degrade", **rec))
+        cov = artifact.meta.get("sharding_coverage")
+        if cov is None:
+            if not findings:
+                return [self.finding(
+                    artifact, "info",
+                    "no sharding-coverage metadata (unmeshed program); "
+                    "pass skipped", code="no-mesh")]
+            return findings
+        mesh = cov.get("mesh") or {}
+        leaves = cov.get("leaves") or {}
+        strict = bool(((context.budget_for(artifact.name) or {})
+                       .get("sharding") or {}).get("strict"))
+        meshed = any(int(v) > 1 for v in mesh.values())
+        matched = unmatched_big = intentional = 0
+        for name in sorted(leaves):
+            rec = leaves[name]
+            shape = rec.get("shape") or []
+            degrade = rec.get("degrade")
+            source = rec.get("source")
+            if degrade:
+                findings.append(self.finding(
+                    artifact, "error",
+                    "param %r matched a partition rule but DEGRADED to "
+                    "full replication (%s, shape %s on mesh %s) — every "
+                    "shard holds the whole tensor; fix the rule or the "
+                    "shape, or waive it explicitly in the budget file"
+                    % (name, degrade, shape, mesh),
+                    code="replicated-degrade", param=name,
+                    degrade=degrade, shape=shape))
+            elif source in ("rule", "plan", "mesh_axes", "naive") \
+                    and rec.get("spec"):
+                matched += 1
+            elif source == "default" and meshed \
+                    and sum(1 for d in shape if int(d) > 1) >= 2:
+                # effective rank counts dims > 1: a [1, 1, 16] LN gain
+                # is a per-feature vector (always an intentional
+                # replicate), a [1, 16, 16] embedding table is not
+                unmatched_big += 1
+                findings.append(self.finding(
+                    artifact, "error" if strict else "info",
+                    "param %r (shape %s) matched NO partition rule and "
+                    "fully replicates on mesh %s — declare a rule or an "
+                    "intentional replicate%s"
+                    % (name, shape, mesh,
+                       "" if strict else " (info: budget has no "
+                       "{'sharding': {'strict': true}})"),
+                    code="unmatched-param", param=name, shape=shape))
+            else:
+                intentional += 1
+        if not findings:
+            findings.append(self.finding(
+                artifact, "info",
+                "%d leaves covered: %d sharded by rule, %d intentional "
+                "replicates, 0 degrades on mesh %s"
+                % (len(leaves), matched, intentional, mesh),
+                code="covered", leaves=len(leaves), sharded=matched,
+                replicated=intentional))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# drift snapshots (mxlint --record / --check)
+# ---------------------------------------------------------------------------
+
+# quantities compared EXACTLY (structural integers: a donation map or a
+# collective count has no tolerance band)
+_DRIFT_EXACT = ("donated", "aliased", "collective_count")
+# quantities compared within the snapshot's relative tolerance
+_DRIFT_PRICED = ("dot_flops", "collective_bytes", "gather_bytes",
+                 "sort_scatter_bytes", "cache_bytes")
+_SNAPSHOT_VERSION = 1
+
+
+def snapshot_hash(snapshot):
+    """Content address of a drift snapshot: a digest over its canonical
+    JSON minus the hash field itself.  ``load_snapshot`` refuses a file
+    whose recorded hash no longer matches — hand-edited baselines must
+    go through ``mxlint --record``, not a text editor."""
+    import hashlib
+    import json
+
+    body = {k: v for k, v in snapshot.items() if k != "content_hash"}
+    blob = json.dumps(body, sort_keys=True, default=str)
+    return hashlib.blake2b(blob.encode(), digest_size=16).hexdigest()
+
+
+def record_snapshot(artifacts, report=None, tolerance=0.02):
+    """Build a drift snapshot dict over built artifacts.
+
+    Per program: the priced quantities
+    (:func:`~mxnet_tpu.analysis.cost.artifact_cost`), the program
+    fingerprint when known, and the pass-finding severity counts from
+    ``report`` (so a baseline records what lint state it was taken in).
+    ``tolerance`` is the relative band the check applies to priced
+    quantities; structural integers compare exactly."""
+    from .cost import artifact_cost
+
+    per_prog = {}
+    if report is not None:
+        for f in report.findings:
+            row = per_prog.setdefault(f.program,
+                                      {"errors": 0, "warnings": 0})
+            if f.severity != "info" and not f.suppressed:
+                row[f.severity + "s"] += 1
+    programs = {}
+    for art in artifacts:
+        row = artifact_cost(art)
+        row["fingerprint"] = art.fingerprint
+        if art.name in per_prog:
+            row["findings"] = per_prog[art.name]
+        programs[art.name] = row
+    snap = {"version": _SNAPSHOT_VERSION, "tolerance": tolerance,
+            "programs": programs}
+    snap["content_hash"] = snapshot_hash(snap)
+    return snap
+
+
+class DriftPass(Pass):
+    """The differential gate: priced quantities vs a recorded snapshot.
+
+    ``mxlint --record <snapshot.json>`` writes the content-addressed
+    baseline; ``mxlint --check <snapshot.json>`` loads it into the
+    context and this pass compares each program's measured
+    :func:`~mxnet_tpu.analysis.cost.artifact_cost` row against it:
+
+    * a priced quantity (dot FLOPs, collective/gather/sort-scatter
+      bytes, cache bytes) GROWN beyond the snapshot's relative
+      tolerance is an **error naming the program and the quantity** —
+      the regression gate the bench trajectory never had;
+    * a structural integer (donated, aliased, collective count) compares
+      exactly;
+    * a quantity that SHRANK beyond tolerance is an *info* row (an
+      improvement to bank: re-record so the gate tightens);
+    * a program missing from the snapshot (or a snapshot program that
+      was not built) is a **warning** — the baseline is stale and must
+      be re-recorded;
+    * a changed fingerprint alone is an *info* row (fingerprints move
+      with any intentional retrace; the priced quantities decide).
+
+    No snapshot loaded -> one info row per program.
+    """
+
+    name = "drift"
+    requires = ()
+
+    def run(self, artifact, context):
+        from .cost import artifact_cost
+
+        snap = context.snapshot
+        if not snap:
+            return [self.finding(
+                artifact, "info",
+                "no drift snapshot loaded; record one with "
+                "tools/mxlint.py --record <snapshot.json>",
+                code="no-snapshot")]
+        findings = []
+        recorded = snap.get("programs", {})
+        row = recorded.get(artifact.name)
+        if row is None:
+            findings.append(self.finding(
+                artifact, "warning",
+                "program absent from the drift snapshot — re-record "
+                "the baseline (tools/mxlint.py --record)",
+                code="new-program"))
+            return findings
+        measured = artifact_cost(artifact)
+        tol = float(snap.get("tolerance", 0.02))
+        drifted = []
+        for key in _DRIFT_EXACT + _DRIFT_PRICED:
+            was, now = row.get(key), measured.get(key)
+            if was is None and now is None:
+                continue
+            if was is None or now is None:
+                findings.append(self.finding(
+                    artifact, "warning",
+                    "quantity %r %s the snapshot but %s this run — "
+                    "surfaces changed; re-record the baseline"
+                    % (key, "missing from" if was is None else "in",
+                       "measured" if was is None else "unmeasured"),
+                    code="asymmetric-quantity", quantity=key,
+                    recorded=was, measured=now))
+                continue
+            if key in _DRIFT_EXACT:
+                if now != was:
+                    drifted.append((key, was, now, "error"))
+                continue
+            band = tol * max(abs(was), 1)
+            if now > was + band:
+                drifted.append((key, was, now, "error"))
+            elif now < was - band:
+                drifted.append((key, was, now, "info"))
+        for key, was, now, sev in drifted:
+            pct = 100.0 * (now - was) / max(abs(was), 1)
+            if sev == "error":
+                findings.append(self.finding(
+                    artifact, "error",
+                    "%s drifted %+.1f%% (%d -> %d) beyond the %.0f%% "
+                    "tolerance without a re-recorded baseline — an "
+                    "intentional change ships with tools/mxlint.py "
+                    "--record, a regression gets fixed"
+                    % (key, pct, was, now, 100 * tol),
+                    code="drift:" + key, quantity=key, recorded=was,
+                    measured=now, tolerance=tol))
+            else:
+                findings.append(self.finding(
+                    artifact, "info",
+                    "%s improved %+.1f%% (%d -> %d); re-record so the "
+                    "gate banks the win" % (key, pct, was, now),
+                    code="improved:" + key, quantity=key, recorded=was,
+                    measured=now))
+        if row.get("fingerprint") and artifact.fingerprint \
+                and row["fingerprint"] != artifact.fingerprint:
+            findings.append(self.finding(
+                artifact, "info",
+                "program fingerprint changed (%s -> %s); priced "
+                "quantities decide whether it matters"
+                % (row["fingerprint"][:12], artifact.fingerprint[:12]),
+                code="fingerprint-changed"))
+        if not findings:
+            findings.append(self.finding(
+                artifact, "info",
+                "all priced quantities within %.0f%% of the snapshot"
+                % (100 * tol), code="within-tolerance"))
         return findings

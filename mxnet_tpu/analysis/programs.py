@@ -65,6 +65,15 @@ immediate retirement all exercised before the trace counters snapshot.
 Dims are tiny: the point is the *program structure* (collectives,
 aliasing, callbacks, dot dtypes, cache bytes), which does not depend on
 size.
+
+The same artifacts feed the three history/placement passes: the meshed
+programs (``ring_tp_step``, ``moe_train_step``) stamp per-leaf
+``sharding_coverage`` meta at placement time for the sharding-coverage
+audit, the drift gate (``mxlint --record/--check``) snapshots every
+program's priced quantities against ``benchmarks/mxlint_snapshot.json``,
+and the schedule pass reads each compiled text — a ``sync-backend`` info
+on this CPU harness, with the async-overlap contract pinned on the
+canned TPU corpus under ``tests/data/hlo/``.
 """
 from __future__ import annotations
 

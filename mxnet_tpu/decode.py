@@ -277,15 +277,21 @@ class DecodePredictor:
             plan = plan_tensor_parallel(symbol) if model_par > 1 else {}
             self._partition_rules = rules_from_plan(plan)
             arg_data = {n: a.data for n, a in arg_params.items()}
+            coverage = {}
+            self._replicated_degrades = []
             shardings = build_shardings(mesh, self._partition_rules,
-                                        arg_data)
+                                        arg_data, coverage=coverage)
+            self._sharding_coverage = {
+                "mesh": {str(k): int(v) for k, v in mesh.shape.items()},
+                "leaves": coverage}
             self._env = {n: jax.device_put(v, shardings[n])
                          for n, v in arg_data.items()}
             self._env.update({n: jax.device_put(a.data, rep)
                               for n, a in aux_params.items()})
             self._cache_sharding = NamedSharding(
                 mesh, kv_cache_pspec(
-                    mesh.shape, num_kv_heads=self._grouped_kv_heads))
+                    mesh.shape, num_kv_heads=self._grouped_kv_heads,
+                    degrades=self._replicated_degrades))
             self._token_sharding = NamedSharding(
                 mesh, P("data" if sizes.get("data", 1) > 1 else None, None))
         else:
@@ -295,6 +301,8 @@ class DecodePredictor:
             self._env.update({n: jax.device_put(a.data, dev)
                               for n, a in aux_params.items()})
             self._token_sharding = dev
+            self._sharding_coverage = None
+            self._replicated_degrades = []
 
         from . import config as _config
 
@@ -855,9 +863,15 @@ class DecodePredictor:
         from .parallel.tp_rules import kv_pool_pspec
 
         spec = kv_pool_pspec(self._mesh.shape,
-                             num_kv_heads=self._grouped_kv_heads)
+                             num_kv_heads=self._grouped_kv_heads,
+                             degrades=self._replicated_degrades)
         if spec[2] is not None and \
                 buf.shape[2] % dict(self._mesh.shape)[spec[2]] != 0:
+            self._replicated_degrades.append({
+                "site": "pool-scale" if is_scale else "pool",
+                "reason": "trailing dim %d %% %s=%d != 0"
+                % (buf.shape[2], spec[2],
+                   dict(self._mesh.shape)[spec[2]])})
             spec = P(None, None, None)
         return jax.device_put(buf, NamedSharding(self._mesh, spec))
 
@@ -1646,6 +1660,19 @@ class DecodePredictor:
                           "source", "jit")
             if src != "jit":
                 meta["aot"] = src
+        # sharding-coverage lint surfaces: the per-leaf partition-rule
+        # match records from placement time, plus every K/V degrade the
+        # pspec helpers took (deduped — _place_pool runs per buffer)
+        if getattr(self, "_sharding_coverage", None) is not None:
+            meta["sharding_coverage"] = self._sharding_coverage
+        degrades, seen = [], set()
+        for rec in getattr(self, "_replicated_degrades", ()):
+            key = (rec.get("site"), rec.get("reason"))
+            if key not in seen:
+                seen.add(key)
+                degrades.append(rec)
+        if degrades:
+            meta["replicated_degrades"] = degrades
         return meta
 
     def _refine_pallas_meta(self, art):

@@ -147,6 +147,10 @@ class DataParallelExecutorGroup:
         # stacks): walk the graph once and map each variable that feeds such
         # an argument to its axis
         axis_sizes = dict(self._mesh.shape)
+        # per-param placement records for the sharding-coverage lint
+        # pass (analysis.passes.ShardingCoveragePass): which params a
+        # plan claimed, which silently degraded to replication
+        self._sharding_coverage = {}
         self._param_mesh_axes = {}
         for node in self.symbol._topo():
             if node.is_variable or not node.op.mesh_axes:
@@ -190,28 +194,46 @@ class DataParallelExecutorGroup:
         """
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # coverage record for the sharding-coverage pass: every exit
+        # below stamps what happened to this param (matched spec,
+        # intentional replicate, or a silent degrade)
+        rec = {"shape": [int(d) for d in shape or ()],
+               "source": "scalar" if not shape else "default"}
+        self._sharding_coverage[name] = rec
         # op-declared axes first (OpDef.mesh_axes — e.g. MoE expert stacks
         # shard dim 0 on 'expert'); graph metadata, not name matching
         axis = self._param_mesh_axes.get(name)
-        if axis is not None and shape \
-                and shape[0] % dict(self._mesh.shape)[axis] == 0:
-            return NamedSharding(
-                self._mesh, P(*([axis] + [None] * (len(shape) - 1))))
+        if axis is not None and shape:
+            if shape[0] % dict(self._mesh.shape)[axis] == 0:
+                spec = [axis] + [None] * (len(shape) - 1)
+                rec["source"], rec["spec"] = "mesh_axes", list(spec)
+                return NamedSharding(self._mesh, P(*spec))
+            # the op DECLARED this axis — losing it to divisibility is
+            # the silent degrade the coverage pass turns into an error
+            rec["source"], rec["degrade"] = "mesh_axes", "indivisible"
         if self._model_par <= 1 or not shape:
             return self._rep_sharding
         if self._tp_plan is not None:
             spec = self._tp_plan.get(name)
-            if spec is None or len(spec) != len(shape):
+            if spec is None:
+                return self._rep_sharding
+            if len(spec) != len(shape):
+                rec["source"], rec["degrade"] = "plan", "rank-mismatch"
                 return self._rep_sharding
             for dim, ax in enumerate(spec):
                 if ax is not None and shape[dim] % self._model_par != 0:
+                    rec["source"], rec["degrade"] = "plan", "indivisible"
                     return self._rep_sharding  # unshardable: replicate
+            rec["source"], rec["spec"] = "plan", list(spec)
+            rec.pop("degrade", None)
             return NamedSharding(self._mesh, P(*spec))
         # naive mode: blanket dim-0 column sharding
         if shape[0] % self._model_par != 0:
             return self._rep_sharding
-        return NamedSharding(self._mesh,
-                             P(*(["model"] + [None] * (len(shape) - 1))))
+        spec = ["model"] + [None] * (len(shape) - 1)
+        if rec.get("degrade") is None:
+            rec["source"], rec["spec"] = "naive", list(spec)
+        return NamedSharding(self._mesh, P(*spec))
 
     def _place(self, arr, sharded, name=None):
         """device_put an NDArray's buffer onto the bound device(s): data

@@ -46,7 +46,7 @@ ELEMENTWISE_OPS = {
 }
 
 
-def _kv_head_axis(sizes, head_axis, num_kv_heads, what):
+def _kv_head_axis(sizes, head_axis, num_kv_heads, what, degrades=None):
     """The trailing-dim mesh axis for a K/V cache/pool, kv-head aware.
 
     MHA (``num_kv_heads`` None/0) keeps the unconditional E-split.  A
@@ -56,6 +56,9 @@ def _kv_head_axis(sizes, head_axis, num_kv_heads, what):
     shard holds all H_kv kv heads; q heads still split) with a warning —
     wrong-but-silent sharding of a grouped pool would interleave kv-head
     slices across shards and score q-heads against the wrong group.
+    ``degrades``, when a list, also records the event as
+    ``{"site", "reason"}`` for the artifact's ``replicated_degrades``
+    meta, which the sharding-coverage lint pass surfaces.
     """
     size = sizes.get(head_axis, 1)
     if size <= 1:
@@ -70,12 +73,17 @@ def _kv_head_axis(sizes, head_axis, num_kv_heads, what):
                 "degrading to replicated-group sharding (each model shard "
                 "holds the full grouped K/V)" % (what, kvh, head_axis,
                                                  size))
+            if degrades is not None:
+                degrades.append({
+                    "site": what,
+                    "reason": "num_kv_heads=%d %% %s=%d != 0"
+                    % (kvh, head_axis, size)})
             return None
     return head_axis
 
 
 def kv_cache_pspec(mesh_shape, batch_axis="data", head_axis="model",
-                   num_kv_heads=None):
+                   num_kv_heads=None, degrades=None):
     """PartitionSpec for a (B, C, E_kv) decode KV cache on a mesh.
 
     The Megatron invariant this module's plan rests on — an E-split IS a
@@ -96,10 +104,11 @@ def kv_cache_pspec(mesh_shape, batch_axis="data", head_axis="model",
     sizes = dict(mesh_shape)
     return P(batch_axis if sizes.get(batch_axis, 1) > 1 else None, None,
              _kv_head_axis(sizes, head_axis, num_kv_heads,
-                           "kv_cache_pspec"))
+                           "kv_cache_pspec", degrades=degrades))
 
 
-def kv_pool_pspec(mesh_shape, head_axis="model", num_kv_heads=None):
+def kv_pool_pspec(mesh_shape, head_axis="model", num_kv_heads=None,
+                  degrades=None):
     """PartitionSpec for a (P, page_tokens, E_kv) paged KV pool on a mesh.
 
     Same Megatron invariant as :func:`kv_cache_pspec` — the trailing E dim
@@ -116,7 +125,7 @@ def kv_pool_pspec(mesh_shape, head_axis="model", num_kv_heads=None):
     sizes = dict(mesh_shape)
     return P(None, None,
              _kv_head_axis(sizes, head_axis, num_kv_heads,
-                           "kv_pool_pspec"))
+                           "kv_pool_pspec", degrades=degrades))
 
 
 def plan_tensor_parallel(symbol):

@@ -49,7 +49,7 @@ def _exact_table(rules):
     return table
 
 
-def match_partition_rules(rules, named_leaves, default=()):
+def match_partition_rules(rules, named_leaves, default=(), coverage=None):
     """``{name: PartitionSpec}`` via first-matching regex per name.
 
     ``named_leaves`` maps parameter names to shape-bearing leaves
@@ -57,8 +57,20 @@ def match_partition_rules(rules, named_leaves, default=()):
     replicate; an unmatched name takes ``default`` (replicated unless
     told otherwise).  ``re.search`` semantics, like fmengine — anchor
     with ``^...$`` for exact names (:func:`rules_from_plan` does).
+
+    ``coverage``, when a dict, receives one record per leaf —
+    ``{"shape": [...], "spec": [...], "source": "scalar|rule|default"}``
+    — the raw material of the sharding-coverage lint pass: which leaves
+    a rule claimed, which fell through to the default.
     """
     from jax.sharding import PartitionSpec as P
+
+    def note(name, shape, spec, source):
+        if coverage is not None:
+            coverage[name] = {"shape": [int(d) for d in shape],
+                              "spec": [str(a) if a is not None else None
+                                       for a in spec],
+                              "source": source}
 
     exact = _exact_table(rules)
     out = {}
@@ -66,32 +78,44 @@ def match_partition_rules(rules, named_leaves, default=()):
         shape = tuple(getattr(leaf, "shape", ()) or ())
         if len(shape) == 0 or int(np.prod(shape)) == 1:
             out[name] = P()
+            note(name, shape, (), "scalar")
             continue
         if exact is not None:
             hit = exact.get(name)
             out[name] = _as_spec(hit if hit is not None else default)
+            note(name, shape, out[name],
+                 "rule" if hit is not None else "default")
             continue
         for patt, spec in rules or ():
             if re.search(patt, name) is not None:
                 out[name] = _as_spec(spec)
+                note(name, shape, out[name], "rule")
                 break
         else:
             out[name] = _as_spec(default)
+            note(name, shape, out[name], "default")
     return out
 
 
-def build_shardings(mesh, rules, named_leaves, default=()):
+def build_shardings(mesh, rules, named_leaves, default=(), coverage=None):
     """``{name: NamedSharding}`` for a named param tree under ``mesh``.
 
     Applies :func:`match_partition_rules`, then the divisibility guard:
     a matched spec is honored only when its rank equals the leaf's and
     every sharded dim divides by its mesh axis size — otherwise the
     leaf replicates (the same degrade rule the decode placement has
-    always used, now in the one shared matcher)."""
+    always used, now in the one shared matcher).
+
+    ``coverage``, when a dict, gets the per-leaf match records
+    (see :func:`match_partition_rules`) with a ``"degrade"`` key
+    (``"rank-mismatch"`` or ``"indivisible"``) stamped on every leaf
+    the guard silently replicated — the degrade used to vanish; now the
+    sharding-coverage pass makes it an error naming the param."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sizes = dict(mesh.shape)
-    specs = match_partition_rules(rules, named_leaves, default)
+    specs = match_partition_rules(rules, named_leaves, default,
+                                  coverage=coverage)
     out = {}
     for name, leaf in named_leaves.items():
         spec = specs[name]
@@ -99,6 +123,11 @@ def build_shardings(mesh, rules, named_leaves, default=()):
         ok = len(spec) == len(shape) and all(
             ax is None or shape[d] % sizes.get(ax, 1) == 0
             for d, ax in enumerate(spec))
+        if not ok and coverage is not None and len(spec) \
+                and coverage.get(name, {}).get("source") != "default":
+            coverage[name]["degrade"] = ("rank-mismatch"
+                                         if len(spec) != len(shape)
+                                         else "indivisible")
         out[name] = NamedSharding(mesh, spec if ok else P())
     return out
 

@@ -1001,6 +1001,15 @@ class CompiledTrainStep:
         ndon = 5 if self._plan is not None else 4
         mesh_shape = dict(group._mesh.shape) if group._mesh is not None \
             else None
+        # sharding-coverage lint surface: the per-param placement records
+        # executor_group._param_sharding stamped at bind time (empty when
+        # no tensor-parallel/mesh-axes placement ran — pass then skips)
+        coverage = None
+        leaves = getattr(group, "_sharding_coverage", None)
+        if mesh_shape is not None and leaves:
+            coverage = {"mesh": {str(k): int(v)
+                                 for k, v in mesh_shape.items()},
+                        "leaves": leaves}
         # the artifact-level PATH_TAKEN tripwire, same contract as
         # decode's meta['pallas_decode']: a plan means the config
         # PROMISED the fused multi-tensor update kernel, and the
@@ -1014,7 +1023,8 @@ class CompiledTrainStep:
             mesh_shape=mesh_shape, trace_count=self.trace_count,
             expected_traces=self.programs_built,
             num_steps=self.num_steps,
-            pallas_update=self._plan is not None)
+            pallas_update=self._plan is not None,
+            sharding_coverage=coverage)
 
     def roofline_static(self, group=None):
         """Static FLOPs + traffic bytes of the fused step program at the

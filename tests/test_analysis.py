@@ -353,12 +353,22 @@ def test_host_sync_pass_clean_program():
 # FLOP/dtype lint
 # ---------------------------------------------------------------------------
 def test_flop_pass_errors_on_uncounted_ops():
+    # a label-less convolution whose output-feature dim matches NO
+    # conventional kernel layout (result features 5, kernel dims
+    # [4,3,3,3]) defeats the shape-inference fallback and must stay a
+    # visible uncounted error
     sh = ("%4 = stablehlo.convolution(%1, %2) : (tensor<1x3x8x8xf32>, "
-          "tensor<4x3x3x3xf32>) -> tensor<1x4x6x6xf32>")
+          "tensor<4x3x3x3xf32>) -> tensor<1x5x6x6xf32>")
     art = _stub("convnet", stablehlo_text=sh, compiled_text=None)
     rep = run_passes([art], passes=[FlopDtypePass()])
     assert any(f.code == "uncounted:stablehlo.convolution"
                for f in rep.errors)
+    # the resolvable layout (features 4 == kernel dim 0) is now COUNTED
+    # by shape inference, not an error (see test_hlo_stats)
+    ok = _stub("convnet", stablehlo_text=sh.replace("1x5x6x6", "1x4x6x6"),
+               compiled_text=None)
+    rep = run_passes([ok], passes=[FlopDtypePass()])
+    assert not any(f.code.startswith("uncounted") for f in rep.errors)
 
 
 def test_flop_pass_flags_f32_dot_in_bf16_program():
@@ -597,3 +607,356 @@ def test_sort_scatter_stats_empty_and_real_lowering():
     assert cost["sort_scatter_bytes"] > 0
     # the term folds into the total bytes floor
     assert cost["bytes"] >= 2 * 128 * 4 + cost["sort_scatter_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# schedule pass (PR: async-overlap analysis) — canned TPU HLO corpus
+# ---------------------------------------------------------------------------
+def _corpus(name):
+    import pathlib
+
+    return (pathlib.Path(__file__).parent / "data" / "hlo"
+            / name).read_text()
+
+
+def _overlap_budget(prog, **kw):
+    ceiling = {"min_pairs": 6, "min_shadow_flops": 1_000_000_000,
+               "max_serialized": 0}
+    ceiling.update(kw)
+    return {"programs": {prog: {"overlap":
+                                {"collective-permute": ceiling}}}}
+
+
+def test_parse_schedule_double_buffered_ring():
+    """The acceptance numbers for the canned n=4 ring: 2*(n-1)=6 matched
+    collective-permute pairs, zero unpaired, and every overlap window
+    shadows the chunk matmul (nonzero FLOPs) plus the half-chunk wire
+    payload."""
+    from mxnet_tpu.analysis.schedule import parse_schedule
+
+    model = parse_schedule(
+        _corpus("ring_collective_permute_overlapped.hlo"))
+    assert len(model.pairs) == 6
+    assert model.unpaired_starts == [] and model.unpaired_dones == []
+    assert model.serialized_pairs() == []
+    for p in model.pairs:
+        assert p.op == "collective-permute"
+        assert p.shadow_flops > 0 and p.shadow_ops > 0
+        assert p.bytes == 2 * 2048 * 2048  # bf16[2048,2048] chunk
+    # each window hides the bf16[2048,2048] x [2048,4096] chunk matmul
+    assert model.pairs[0].shadow_flops == 2 * 2048 * 2048 * 4096
+
+
+def test_schedule_pass_ring_meets_overlap_budget():
+    art = _stub("ring_tpu", compiled_text=_corpus(
+        "ring_collective_permute_overlapped.hlo"))
+    from mxnet_tpu.analysis.schedule import SchedulePass
+
+    rep = run_passes([art], passes=[SchedulePass()],
+                     budgets=_overlap_budget("ring_tpu"))
+    assert rep.errors == [], [f.message for f in rep.errors]
+    info = next(f for f in rep.findings if f.code == "overlapped")
+    assert info.detail["pairs"] == 6
+
+
+def test_schedule_pass_serialized_ring_fails_overlap_budget():
+    """The same ring with every -done retiring its -start immediately:
+    the async split hides nothing, and the overlap budget (which says
+    this program PAYS for latency hiding) must flag all six pairs."""
+    art = _stub("ring_tpu", compiled_text=_corpus(
+        "ring_collective_permute_serialized.hlo"))
+    from mxnet_tpu.analysis.schedule import SchedulePass
+
+    rep = run_passes([art], passes=[SchedulePass()],
+                     budgets=_overlap_budget("ring_tpu"))
+    ser = [f for f in rep.errors if f.code == "serialized-pair"]
+    assert ser and ser[0].detail["measured"] == 6
+    # without a budget the same schedule is a visible info, not an error
+    rep = run_passes([art], passes=[SchedulePass()])
+    assert rep.errors == []
+    assert any(f.code == "serialized-pair" and f.severity == "info"
+               for f in rep.findings)
+
+
+def test_schedule_pass_unpaired_start_always_error():
+    art = _stub("broken", compiled_text=_corpus(
+        "unpaired_collective_permute_start.hlo"))
+    from mxnet_tpu.analysis.schedule import SchedulePass
+
+    rep = run_passes([art], passes=[SchedulePass()])  # no budget at all
+    assert len(rep.errors) == 1
+    assert rep.errors[0].code == "unpaired-start"
+    assert "cp-start.1" in rep.errors[0].message
+
+
+def test_schedule_pass_mixed_async_families_and_sync_backend():
+    from mxnet_tpu.analysis.schedule import SchedulePass, parse_schedule
+
+    model = parse_schedule(_corpus("async_mixed_overlap.hlo"))
+    assert sorted(p.op for p in model.pairs) == \
+        ["all-gather", "all-reduce", "copy"]
+    assert all(not p.serialized for p in model.pairs)
+    # XLA:CPU keeps sync collectives: no pairs -> info row, never errors
+    rep = run_passes([_stub("cpu_prog")], passes=[SchedulePass()])
+    assert rep.errors == []
+    assert [f.code for f in rep.findings] == ["sync-backend"]
+
+
+def test_schedule_pass_missing_pairs_floor():
+    """A budget promising more pairs than the schedule carries means the
+    latency-hiding structure was lost (sync legalization)."""
+    art = _stub("ring_tpu", compiled_text=_corpus(
+        "ring_collective_permute_overlapped.hlo"))
+    from mxnet_tpu.analysis.schedule import SchedulePass
+
+    rep = run_passes([art], passes=[SchedulePass()],
+                     budgets=_overlap_budget("ring_tpu", min_pairs=8))
+    assert any(f.code == "missing-pairs" for f in rep.errors)
+
+
+# ---------------------------------------------------------------------------
+# sharding-coverage pass (PR: partition-rule coverage audit)
+# ---------------------------------------------------------------------------
+def _cov_art(name="tp_prog", leaves=None, mesh=None, degrades=None):
+    meta = {}
+    if leaves is not None:
+        meta["sharding_coverage"] = {
+            "mesh": mesh or {"data": 2, "model": 2},
+            "leaves": leaves}
+    if degrades is not None:
+        meta["replicated_degrades"] = degrades
+    return _stub(name, meta=meta)
+
+
+def test_sharding_coverage_degrade_is_error_naming_param():
+    from mxnet_tpu.analysis.passes import ShardingCoveragePass
+
+    art = _cov_art(leaves={
+        "layer0_ffn_w1": {"shape": [16, 48], "source": "rule",
+                          "degrade": "indivisible"},
+        "layer0_attn_q": {"shape": [16, 16], "source": "rule",
+                          "spec": [None, "model"]}})
+    rep = run_passes([art], passes=[ShardingCoveragePass()])
+    assert len(rep.errors) == 1
+    err = rep.errors[0]
+    assert err.code == "replicated-degrade"
+    assert "layer0_ffn_w1" in err.message and "indivisible" in err.message
+
+
+def test_sharding_coverage_unmatched_param_strict_vs_info():
+    from mxnet_tpu.analysis.passes import ShardingCoveragePass
+
+    art = _cov_art(leaves={
+        "pos_embed_weight": {"shape": [1, 16, 16], "source": "default"}})
+    rep = run_passes([art], passes=[ShardingCoveragePass()])
+    assert rep.errors == []
+    info = next(f for f in rep.findings if f.code == "unmatched-param")
+    assert info.severity == "info" and "pos_embed_weight" in info.message
+    # the budget opts the program into strict coverage -> error
+    rep = run_passes(
+        [art], passes=[ShardingCoveragePass()],
+        budgets={"programs": {"tp_prog": {"sharding": {"strict": True}}}})
+    assert len(rep.errors) == 1
+    assert rep.errors[0].code == "unmatched-param"
+
+
+def test_sharding_coverage_vectors_and_scalars_are_intentional():
+    """Effective rank < 2 (scalars, [16] biases, [1,1,16] LN gains)
+    always counts as an intentional replicate — even under strict."""
+    from mxnet_tpu.analysis.passes import ShardingCoveragePass
+
+    art = _cov_art(leaves={
+        "step": {"shape": [], "source": "scalar"},
+        "layer0_ln_bias": {"shape": [16], "source": "default"},
+        "layer0_ln_gain": {"shape": [1, 1, 16], "source": "default"},
+        "layer0_attn_q": {"shape": [16, 16], "source": "plan",
+                          "spec": [None, "model"]}})
+    rep = run_passes(
+        [art], passes=[ShardingCoveragePass()],
+        budgets={"programs": {"tp_prog": {"sharding": {"strict": True}}}})
+    assert rep.errors == []
+    cov = next(f for f in rep.findings if f.code == "covered")
+    assert cov.detail["sharded"] == 1 and cov.detail["replicated"] == 3
+
+
+def test_sharding_coverage_kv_degrade_visible_info():
+    from mxnet_tpu.analysis.passes import ShardingCoveragePass
+
+    art = _cov_art(degrades=[
+        {"site": "kv-cache", "reason": "num_kv_heads=2 % model=4 != 0"}])
+    rep = run_passes([art], passes=[ShardingCoveragePass()])
+    assert rep.errors == []
+    row = next(f for f in rep.findings
+               if f.code == "kv-replicated-degrade")
+    assert row.severity == "info" and "kv-cache" in row.message
+
+
+def test_sharding_coverage_unmeshed_program_skips():
+    from mxnet_tpu.analysis.passes import ShardingCoveragePass
+
+    rep = run_passes([_stub("decode_step")],
+                     passes=[ShardingCoveragePass()])
+    assert [f.code for f in rep.findings] == ["no-mesh"]
+    assert rep.errors == []
+
+
+# ---------------------------------------------------------------------------
+# drift pass (PR: mxlint --record / --check differential gate)
+# ---------------------------------------------------------------------------
+def _drift_art(name="ring_tpu"):
+    # a stub with real collective bytes + cache meta so the priced
+    # quantities are nonzero (the corpus ring carries 6 cp transfers)
+    return _stub(name, compiled_text=_corpus(
+        "ring_collective_permute_overlapped.hlo"),
+        meta={"cache_bytes": 4096})
+
+
+def test_drift_record_check_roundtrip_green():
+    from mxnet_tpu.analysis import record_snapshot, snapshot_hash
+    from mxnet_tpu.analysis.passes import DriftPass
+
+    art = _drift_art()
+    snap = record_snapshot([art])
+    assert snap["content_hash"] == snapshot_hash(snap)
+    row = snap["programs"]["ring_tpu"]
+    assert row["collective_bytes"] > 0 and row["cache_bytes"] == 4096
+    rep = run_passes([art], passes=[DriftPass()], snapshot=snap)
+    assert rep.errors == []
+    assert [f.code for f in rep.findings] == ["within-tolerance"]
+
+
+def test_drift_regression_fails_naming_program_and_quantity():
+    """The acceptance case: +10% collective bytes vs the recorded
+    baseline is an error naming the program and the quantity."""
+    from mxnet_tpu.analysis import record_snapshot
+    from mxnet_tpu.analysis.passes import DriftPass
+
+    art = _drift_art()
+    snap = record_snapshot([art])
+    row = snap["programs"]["ring_tpu"]
+    # rewind the baseline so this run's measurement reads +10%; counts
+    # must agree or the EXACT comparison fires first
+    row["collective_bytes"] = int(row["collective_bytes"] / 1.1)
+    rep = run_passes([art], passes=[DriftPass()], snapshot=snap)
+    assert len(rep.errors) == 1
+    err = rep.errors[0]
+    assert err.code == "drift:collective_bytes"
+    assert err.program == "ring_tpu"
+    assert "collective_bytes" in err.message and "%" in err.message
+
+
+def test_drift_improvement_and_exact_quantities():
+    from mxnet_tpu.analysis import record_snapshot
+    from mxnet_tpu.analysis.passes import DriftPass
+
+    art = _drift_art()
+    snap = record_snapshot([art])
+    # a SHRUNK priced quantity is an improvement to bank, not an error
+    snap["programs"]["ring_tpu"]["cache_bytes"] = 8192
+    rep = run_passes([art], passes=[DriftPass()], snapshot=snap)
+    assert rep.errors == []
+    assert any(f.code == "improved:cache_bytes" for f in rep.findings)
+    # structural integers have no tolerance band at all
+    snap = record_snapshot([art])
+    snap["programs"]["ring_tpu"]["collective_count"] += 1
+    rep = run_passes([art], passes=[DriftPass()], snapshot=snap)
+    assert any(f.code == "drift:collective_count" for f in rep.errors)
+
+
+def test_drift_new_program_warns_and_no_snapshot_is_info():
+    from mxnet_tpu.analysis import record_snapshot
+    from mxnet_tpu.analysis.passes import DriftPass
+
+    art = _drift_art()
+    snap = record_snapshot([_drift_art("other_prog")])
+    rep = run_passes([art], passes=[DriftPass()], snapshot=snap)
+    assert rep.errors == []
+    assert any(f.code == "new-program" and f.severity == "warning"
+               for f in rep.findings)
+    rep = run_passes([art], passes=[DriftPass()])  # no snapshot loaded
+    assert [f.code for f in rep.findings] == ["no-snapshot"]
+
+
+def test_load_snapshot_refuses_hand_edited_baseline(tmp_path):
+    import json as _json
+
+    from mxnet_tpu.analysis import record_snapshot
+
+    snap = record_snapshot([_drift_art()])
+    path = tmp_path / "snap.json"
+    path.write_text(_json.dumps(snap))
+    assert analysis.load_snapshot(str(path))["version"] == 1
+    # a hand edit (no re-record) breaks the content address
+    snap["programs"]["ring_tpu"]["collective_bytes"] = 1
+    path.write_text(_json.dumps(snap))
+    with pytest.raises(ValueError, match="content hash mismatch"):
+        analysis.load_snapshot(str(path))
+
+
+# ---------------------------------------------------------------------------
+# stale suppressions (PR satellite: suppression-interaction lint)
+# ---------------------------------------------------------------------------
+def test_stale_budget_suppression_becomes_info():
+    art = _stub(donated_leaves=1)
+    # matches the live dropped-donation finding: no stale row
+    rep = run_passes([art], passes=[DonationPass()],
+                     budgets={"suppressions": ["donation:prog"]})
+    assert not any(f.code == "stale-suppression" for f in rep.findings)
+    # the waived issue stopped firing: the dead waiver surfaces
+    rep = run_passes([art], passes=[DonationPass()],
+                     budgets={"suppressions": ["donation:otherprog"]})
+    stale = next(f for f in rep.findings if f.code == "stale-suppression")
+    assert stale.severity == "info" and stale.pass_name == "suppressions"
+    assert "donation:otherprog" in stale.message
+    assert rep.errors and rep.errors[0].code == "dropped-donation"
+    # session-local (argument/env) suppressions are exempt
+    rep = run_passes([art], passes=[DonationPass()],
+                     suppressions="donation:otherprog")
+    assert not any(f.code == "stale-suppression" for f in rep.findings)
+
+
+# ---------------------------------------------------------------------------
+# mxlint CLI contract: github annotations + exit codes
+# ---------------------------------------------------------------------------
+def _mxlint():
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_mxlint_under_test", os.path.join(root, "tools", "mxlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mxlint_format_github_annotations():
+    mxlint = _mxlint()
+    art = _stub(donated_leaves=2)
+    rep = run_passes([art], passes=[DonationPass()])
+    lines = mxlint.format_github(rep)
+    assert len(lines) == 1
+    line = lines[0]
+    assert line.startswith("::error file=benchmarks/budgets.json,line=1,")
+    assert "title=donation(prog):dropped-donation" in line
+    # workflow-command escaping: no raw newlines or percents in the data
+    rep.findings[0].message = "50% lost\nsecond line"
+    assert "::50%25 lost%0Asecond line" in mxlint.format_github(rep)[0]
+    # suppressed findings stay off the PR
+    rep = run_passes([art], passes=[DonationPass()],
+                     suppressions="donation")
+    assert mxlint.format_github(rep) == []
+
+
+def test_mxlint_exit_code_contract():
+    """0 clean/info-only, 1 unsuppressed errors; 2 (usage/bad --check
+    input) is pinned by test_bench_contract's subprocess runs."""
+    mxlint = _mxlint()
+    art = _stub(donated_leaves=1)
+    assert mxlint._exit_code(run_passes([art],
+                                        passes=[DonationPass()])) == 1
+    assert mxlint._exit_code(run_passes([art], passes=[DonationPass()],
+                                        suppressions="donation")) == 0
+    clean = _stub()
+    assert mxlint._exit_code(run_passes([clean],
+                                        passes=[DonationPass()])) == 0

@@ -537,13 +537,19 @@ def test_mxlint_smoke_contract():
     ckpt_train_step by a real fit under async fenced checkpointing;
     moe_train_step by a real top-2 capacity-routed MoE LM step whose
     explicit all-to-all dispatch the collective pass budgets) with
-    all seven passes and report ZERO unsuppressed findings — the
+    all ten passes and report ZERO unsuppressed findings — the
     static-analysis acceptance line: donation aliasing, collective
     budgets, retrace counts, host-sync lint, FLOP/dtype coverage,
-    cache-byte budgets (pool bytes for the paged programs) and the
+    cache-byte budgets (pool bytes for the paged programs), the
     tuner-coverage audit (every Pallas block constant registered with
-    ops/tuning) all green against benchmarks/budgets.json on the
-    8-virtual-device CPU platform."""
+    ops/tuning), the async-overlap schedule pass (sync-backend info on
+    CPU — the TPU contract lives on the canned corpus), the
+    sharding-coverage audit and the DRIFT GATE — the run checks the
+    committed benchmarks/mxlint_snapshot.json baseline, so a PR that
+    regresses a priced quantity (FLOPs, collective/cache bytes) beyond
+    tolerance without re-recording fails tier-1 right here — all green
+    against benchmarks/budgets.json on the 8-virtual-device CPU
+    platform."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     # scrub analysis knobs: the smoke must measure the committed budget
@@ -552,7 +558,8 @@ def test_mxlint_smoke_contract():
         env.pop(key)
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
-         "--smoke"],
+         "--smoke", "--check",
+         os.path.join(ROOT, "benchmarks", "mxlint_snapshot.json")],
         capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
     assert proc.returncode == 0, (proc.stdout, proc.stderr[-3000:])
 
@@ -566,14 +573,32 @@ def test_mxlint_smoke_contract():
     assert head["errors"] == 0 and head["warnings"] == 0, head
     # every canonical program was built (the virtual mesh gives ring×TP
     # and the expert-parallel MoE step)
-    assert head["programs"] == 13 and head["passes"] == 7, head
+    assert head["programs"] == 13 and head["passes"] == 10, head
     assert head["skipped_programs"] == [], head
+    # the drift gate really checked every program against the committed
+    # baseline, and nothing drifted; CPU keeps sync collectives, so the
+    # schedule pass sees no async pairs (the TPU contract is pinned on
+    # the canned corpus in test_analysis)
+    assert head["drift_checked"] == 13 and head["drifted"] == 0, head
+    assert head["schedule_unpaired"] == 0, head
 
     # stderr: one JSON finding per line; every (pass, program) pair ran
     rows = [json.loads(ln) for ln in proc.stderr.splitlines()
             if ln.strip().startswith("{")]
     pairs = {(r["pass"], r["program"]) for r in rows if "pass" in r}
-    assert len(pairs) == 91, sorted(pairs)
+    assert len(pairs) == 130, sorted(pairs)
+    # every program compared within tolerance against the snapshot
+    drift_rows = [r for r in rows if r.get("pass") == "drift"]
+    assert len(drift_rows) == 13, drift_rows
+    assert all(r["code"] == "within-tolerance" for r in drift_rows), \
+        drift_rows
+    # the meshed programs carry sharding-coverage metadata end to end
+    # (no 'no-mesh' skip): their replicates are all visible, intentional
+    shard_rows = {r["program"]: r["code"] for r in rows
+                  if r.get("pass") == "sharding-coverage"}
+    for prog in ("ring_tp_step", "moe_train_step"):
+        assert shard_rows.get(prog) in ("covered", "unmatched-param"), \
+            (prog, shard_rows.get(prog))
     # the expert-parallel step's committed all-to-all ceiling is live:
     # the collective pass measured real exchanges within budget
     a2a_row = next(r for r in rows

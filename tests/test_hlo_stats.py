@@ -7,13 +7,26 @@ with real-shaped HLO lines: nested tuple shapes under TPU layout
 annotations, grouped async -start tuples, context-scalar filtering, the
 all-reduce-start flat-tuple layout, sub-byte dtypes, and the
 uncounted-op reporting for dot-like ops the FLOP counter cannot model.
+
+Multi-line fixtures live in the canned corpus under ``tests/data/hlo/``
+(provenance in its README) so the schedule-pass tests and these share
+one set of real-shaped texts; one-line snippets stay inline.
 """
+import pathlib
+
 import pytest
 
 from mxnet_tpu.parallel.hlo_stats import (collective_stats, dot_flops,
                                           dot_flops_report,
                                           input_output_aliases, shape_bytes,
                                           shape_bytes_report)
+
+_CORPUS = pathlib.Path(__file__).parent / "data" / "hlo"
+
+
+def corpus(name):
+    """A canned HLO/StableHLO text from tests/data/hlo/."""
+    return (_CORPUS / name).read_text()
 
 
 # ---------------------------------------------------------------------------
@@ -56,11 +69,7 @@ def test_shape_bytes_tpu_layout_annotations():
 def test_all_reduce_start_flat_tuple_counts_every_buffer():
     # all-reduce-start has the SYNC op's shape: a flat tuple of results
     # when XLA combined several all-reduces — every buffer counts
-    hlo = """
-  %ars = (f32[128]{0}, f32[64]{0}) all-reduce-start(f32[128]{0} %a, f32[64]{0} %b), replica_groups={}
-  %ard = (f32[128]{0}, f32[64]{0}) all-reduce-done((f32[128]{0}, f32[64]{0}) %ars)
-"""
-    st = collective_stats(hlo)
+    st = collective_stats(corpus("all_reduce_start_flat_tuple.hlo"))
     assert st["all-reduce"]["count"] == 1
     assert st["all-reduce"]["bytes"] == 128 * 4 + 64 * 4
     assert st["overlappable"]["count"] == 1
@@ -69,11 +78,7 @@ def test_all_reduce_start_flat_tuple_counts_every_buffer():
 
 def test_reduce_scatter_start_counts_result_only():
     # (operand, result, ctx...) — counting the operand too would double
-    hlo = """
-  %rs = (f32[256]{0}, f32[64]{0}, u32[], u32[]) reduce-scatter-start(f32[256]{0} %x), dimensions={0}
-  %rsd = f32[64]{0} reduce-scatter-done((f32[256]{0}, f32[64]{0}, u32[], u32[]) %rs)
-"""
-    st = collective_stats(hlo)
+    st = collective_stats(corpus("reduce_scatter_start_result_only.hlo"))
     assert st["reduce-scatter"]["count"] == 1
     assert st["reduce-scatter"]["bytes"] == 64 * 4
 
@@ -107,11 +112,8 @@ def test_context_scalar_filtering_and_permute():
 
 
 def test_done_lines_not_double_counted():
-    hlo = """
-  %s = (f32[8]{0}, f32[8]{0}, u32[]) collective-permute-start(f32[8]{0} %x), source_target_pairs={{0,1}}
-  %d = f32[8]{0} collective-permute-done((f32[8]{0}, f32[8]{0}, u32[]) %s)
-"""
-    st = collective_stats(hlo)
+    st = collective_stats(
+        corpus("collective_permute_pair_counted_once.hlo"))
     assert st["collective-permute"]["count"] == 1
     assert st["total"]["count"] == 1
 
@@ -121,11 +123,7 @@ def test_done_lines_not_double_counted():
 # the regex matched for years with zero coverage; these pin it)
 # ---------------------------------------------------------------------------
 def test_all_to_all_sync_counted():
-    hlo = """
-  %a2a = f32[8,16]{1,0} all-to-all(f32[8,16]{1,0} %x), replica_groups={{0,1,2,3}}, dimensions={0}
-  %a2a.done.decoy = f32[8,16]{1,0} add(f32[8,16]{1,0} %a2a, f32[8,16]{1,0} %a2a)
-"""
-    st = collective_stats(hlo)
+    st = collective_stats(corpus("all_to_all_sync.hlo"))
     assert st["all-to-all"] == {"count": 1, "bytes": 8 * 16 * 4}
     assert st["overlappable"] == {"count": 0, "bytes": 0}
 
@@ -133,10 +131,7 @@ def test_all_to_all_sync_counted():
 def test_all_to_all_sync_tuple_operands_sum():
     # multi-operand sync all-to-all carries a tuple result: every buffer
     # is real exchanged payload, so the bytes sum over the tuple
-    hlo = """
-  %a2a.t = (f32[4,8]{1,0}, bf16[4,8]{1,0}) all-to-all(f32[4,8]{1,0} %x, bf16[4,8]{1,0} %y), replica_groups={{0,1},{2,3}}, dimensions={1}
-"""
-    st = collective_stats(hlo)
+    st = collective_stats(corpus("all_to_all_sync_tuple.hlo"))
     assert st["all-to-all"] == {"count": 1,
                                 "bytes": 4 * 8 * 4 + 4 * 8 * 2}
 
@@ -144,11 +139,7 @@ def test_all_to_all_sync_tuple_operands_sum():
 def test_all_to_all_async_start_done_pair_counts_once():
     # async pair: the -start carries ((operands), result[, ctx]) — count
     # the result once, mark it overlappable, never count the -done
-    hlo = """
-  %a2a-start = ((f32[2,64]{1,0:T(8,128)}), f32[2,64]{1,0:T(8,128)}) all-to-all-start(f32[2,64]{1,0:T(8,128)} %p0), replica_groups={{0,1,2,3}}, dimensions={1}
-  %a2a-done = f32[2,64]{1,0:T(8,128)} all-to-all-done(((f32[2,64]{1,0:T(8,128)}), f32[2,64]{1,0:T(8,128)}) %a2a-start)
-"""
-    st = collective_stats(hlo)
+    st = collective_stats(corpus("all_to_all_async_pair.hlo"))
     assert st["all-to-all"] == {"count": 1, "bytes": 2 * 64 * 4}
     assert st["overlappable"] == {"count": 1, "bytes": 2 * 64 * 4}
     assert st["total"]["count"] == 1
@@ -157,11 +148,7 @@ def test_all_to_all_async_start_done_pair_counts_once():
 def test_all_to_all_async_grouped_tuple_result():
     # grouped async form: operand pack and result pack are both tuples;
     # the result tuple's buffers all count (sum), the operand pack never
-    hlo = """
-  %a2a-start.2 = ((f32[4]{0}, f32[8]{0}), (f32[4]{0}, f32[8]{0})) all-to-all-start(f32[4]{0} %a, f32[8]{0} %b), replica_groups={{0,1}}
-  %a2a-done.2 = (f32[4]{0}, f32[8]{0}) all-to-all-done(((f32[4]{0}, f32[8]{0}), (f32[4]{0}, f32[8]{0})) %a2a-start.2)
-"""
-    st = collective_stats(hlo)
+    st = collective_stats(corpus("all_to_all_async_grouped.hlo"))
     assert st["all-to-all"] == {"count": 1, "bytes": 4 * 4 + 8 * 4}
 
 
@@ -172,11 +159,8 @@ def test_all_to_all_async_grouped_tuple_result():
 def test_stablehlo_collectives_one_line_ops():
     from mxnet_tpu.analysis.hlo_parse import stablehlo_collective_stats
 
-    txt = """
-    %0 = "stablehlo.all_to_all"(%arg0) <{concat_dimension = 1 : i64, replica_groups = dense<[[0, 1, 2, 3]]> : tensor<1x4xi64>, split_count = 4 : i64, split_dimension = 0 : i64}> : (tensor<8x2x6xf32>) -> tensor<2x8x6xf32>
-    %1 = "stablehlo.collective_permute"(%0) <{source_target_pairs = dense<[[0, 1]]> : tensor<1x2xi64>}> : (tensor<2x8x6xf32>) -> tensor<2x8x6xf32>
-"""
-    st = stablehlo_collective_stats(txt)
+    st = stablehlo_collective_stats(
+        corpus("stablehlo_collectives_one_line.mlir"))
     assert st["all-to-all"] == {"count": 1, "bytes": 2 * 8 * 6 * 4}
     assert st["collective-permute"] == {"count": 1, "bytes": 2 * 8 * 6 * 4}
     assert st["total"]["count"] == 2
@@ -187,14 +171,8 @@ def test_stablehlo_all_reduce_region_signature_on_closing_line():
     # closing line; the pending queue must match them up
     from mxnet_tpu.analysis.hlo_parse import stablehlo_collective_stats
 
-    txt = """
-    %2 = "stablehlo.all_reduce"(%1) <{replica_groups = dense<[[0, 1]]> : tensor<1x2xi64>}> ({
-    ^bb0(%a: tensor<f32>, %b: tensor<f32>):
-      %s = stablehlo.add %a, %b : tensor<f32>
-      stablehlo.return %s : tensor<f32>
-    }) : (tensor<16x4xbf16>) -> tensor<16x4xbf16>
-"""
-    st = stablehlo_collective_stats(txt)
+    st = stablehlo_collective_stats(
+        corpus("stablehlo_all_reduce_region.mlir"))
     assert st["all-reduce"] == {"count": 1, "bytes": 16 * 4 * 2}
 
 
@@ -262,17 +240,39 @@ def test_dot_flops_grouped_convolution_counted():
     assert dot_flops(line) == 2 * (1 * 8 * 6 * 6) * (4 * 3 * 3)
 
 
-def test_dot_flops_labelless_convolution_reported_uncounted():
-    # convolutions WITHOUT dim metadata cannot be modeled — they must
-    # surface as uncounted, not read as zero silently
-    text = """
-%4 = stablehlo.convolution(%1, %2) dim_numbers = [b, f, 0, 1] : (tensor<1x3x8x8xf32>, tensor<4x3x3x3xf32>) -> tensor<1x4x6x6xf32>
-  %conv.1 = f32[1,4,6,6]{3,2,1,0} convolution(f32[1,3,8,8]{3,2,1,0} %x, f32[4,3,3,3]{3,2,1,0} %w), window={size=3x3}
-"""
-    rep = dot_flops_report(text)
+def test_dot_flops_labelless_convolution_inferred_from_shapes():
+    # convolutions stripped of dim metadata (debug dumps, minimized
+    # repros) used to surface as uncounted — the shape fallback now
+    # infers the contraction from the conventional kernel layout (HLO
+    # oi01: o first; StableHLO [0,1,i,o]: o last), cross-checked
+    # against the result dims, and flags the records "inferred"
+    rep = dot_flops_report(corpus("conv_labelless_pair.txt"))
+    # both lines describe the same 3x3 conv, 3 in / 4 out channels:
+    # 2 * (1*4*6*6) result elements * (3*3*3) contraction, each
+    assert rep["flops"] == 2 * 2 * (1 * 4 * 6 * 6) * (3 * 3 * 3)
+    assert rep["uncounted_ops"] == []
+    assert [d["op"] for d in rep["dots"]] == ["stablehlo.convolution",
+                                              "convolution"]
+    assert all(d["inferred"] for d in rep["dots"])
+    # dim-role parsing stays PREFERRED: a labeled line never takes the
+    # fallback and carries no inferred flag
+    labeled = dot_flops_report(
+        "  %conv.1 = f32[1,4,6,6]{3,2,1,0} convolution("
+        "f32[1,3,8,8]{3,2,1,0} %x, f32[4,3,3,3]{3,2,1,0} %w), "
+        "window={size=3x3}, dim_labels=bf01_oi01->bf01")
+    assert labeled["flops"] == 2 * (1 * 4 * 6 * 6) * (3 * 3 * 3)
+    assert "inferred" not in labeled["dots"][0]
+
+
+def test_dot_flops_labelless_convolution_unresolvable_stays_uncounted():
+    # shapes that defeat the o-dim cross-check (no kernel dim appears
+    # in the result) must still surface as uncounted, never read as 0
+    rep = dot_flops_report(
+        "  %conv.9 = f32[1,5,6,6]{3,2,1,0} convolution("
+        "f32[1,3,8,8]{3,2,1,0} %x, f32[4,3,3,3]{3,2,1,0} %w), "
+        "window={size=3x3}")
     assert rep["flops"] == 0
-    ops = {r["op"]: r["count"] for r in rep["uncounted_ops"]}
-    assert ops == {"stablehlo.convolution": 1, "convolution": 1}
+    assert rep["uncounted_ops"] == [{"op": "convolution", "count": 1}]
 
 
 def test_shape_str_renders_hlo_shapes():

@@ -13,7 +13,7 @@ real top-2 capacity-routed MoE LM step whose explicit all-to-all
 dispatch the collective pass budgets), snapshots each as a
 :class:`~mxnet_tpu.analysis.artifact.ProgramArtifact` (jaxpr + lowered
 StableHLO + compiled HLO + donation/retrace/dtype/cache metadata), and
-runs the six analysis passes against the committed budget file:
+runs the ten analysis passes against the committed budget file:
 
 ==================  =====================================================
 pass                invariant it pins
@@ -25,24 +25,45 @@ host-sync           no host-callback primitives / host-transfer HLO ops
 flop-dtype          dot_flops coverage; no f32 dots in bf16 programs
 cache-bytes         decode KV-cache bytes <= ceiling; quantized configs
                     store narrow data planes
+tuner-coverage      Pallas block/split constants registered with the
+                    autotuner (no dead hand-tuned shapes)
+schedule            async -start/-done pairs matched; compute shadows
+                    above the per-program ``overlap`` floors
+sharding-coverage   every bound param resolves to a rule match or an
+                    INTENTIONAL replicate; silent degrades are errors
+drift               priced quantities (FLOPs, collective/cache bytes,
+                    donation map) vs a recorded snapshot (``--check``)
 ==================  =====================================================
 
 Output follows the bench.py contract: ONE json line on stdout —
 ``{"metric": "mxlint_unsuppressed_findings", "value", "unit",
-"vs_baseline", ...}`` — with per-finding detail json on stderr, one line
-each.  Exit is nonzero when any unsuppressed *error* finding survives,
-so CI fails on a dropped donation / budget overrun / retrace the same
-way it fails on a broken test.
+"vs_baseline", ...}`` — with per-finding detail on stderr in the
+``--format`` of choice (default ``jsonl``: one json object per line).
+
+Exit-code contract (unit-tested in tests/test_analysis.py):
+
+* **0** — clean, or info-only findings (info never fails a run);
+* **1** — at least one unsuppressed *error* finding survived;
+* **2** — usage / input error (unknown flag, unreadable or
+  hash-mismatched ``--check`` snapshot), the argparse convention.
 
 Workflow (docs/static_analysis.md):
 
 * ``tools/mxlint.py --smoke``           — the tier-1 CI entry
-  (tests/test_bench_contract.py invokes it);
+  (tests/test_bench_contract.py invokes it, with ``--check`` against
+  the committed ``benchmarks/mxlint_snapshot.json``);
 * ``tools/mxlint.py --update-budgets``  — re-measure and rewrite the
   budget ceilings after an *intentional* sharding/collective change
   (preserves the file's suppressions list);
-* ``tools/mxlint.py --programs decode_step --text``  — human-readable
-  audit of a subset while iterating.
+* ``tools/mxlint.py --smoke --record benchmarks/mxlint_snapshot.json``
+  — re-record the drift baseline after an intentional perf change;
+* ``tools/mxlint.py --smoke --check benchmarks/mxlint_snapshot.json``
+  — the differential gate: a PR that regresses a priced quantity
+  beyond tolerance fails here, naming the program and the quantity;
+* ``tools/mxlint.py --programs decode_step --format text``  —
+  human-readable audit of a subset while iterating;
+* ``tools/mxlint.py --smoke --format github`` — CI annotations
+  (``::error file=...``) on stderr for unsuppressed findings.
 
 Suppressions: ``pass[:program[:code]]`` globs, from the budget file's
 ``suppressions`` list, ``MXNET_ANALYSIS_SUPPRESS``, or ``--suppress``.
@@ -82,7 +103,7 @@ def _parse_args(argv):
         "compiled programs (see docs/static_analysis.md)")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 CI mode: force the 8-virtual-device CPU "
-                    "platform and audit all twelve programs")
+                    "platform and audit all thirteen programs")
     ap.add_argument("--programs", default="",
                     help="comma-filter of canonical programs (default all)")
     ap.add_argument("--budgets", default="",
@@ -93,12 +114,51 @@ def _parse_args(argv):
     ap.add_argument("--update-budgets", action="store_true",
                     help="rewrite the budget file's per-program collective "
                     "ceilings from this run's measurements and exit")
+    ap.add_argument("--record", default="", metavar="PATH",
+                    help="write a content-addressed drift snapshot of this "
+                    "run's priced quantities to PATH (the --check baseline)")
+    ap.add_argument("--check", default="", metavar="PATH",
+                    help="load a drift snapshot and arm the drift pass: a "
+                    "priced quantity regressing beyond its tolerance is an "
+                    "error naming the program and quantity")
+    ap.add_argument("--format", default="", dest="fmt",
+                    choices=("jsonl", "json", "github", "text"),
+                    help="stderr finding format: jsonl (default; one json "
+                    "object per line), json (one report document), github "
+                    "(::error/::warning workflow annotations for "
+                    "unsuppressed findings), text (human-readable)")
     ap.add_argument("--text", action="store_true",
-                    help="human-readable report on stderr instead of "
-                    "per-finding json lines")
+                    help="alias for --format text")
     ap.add_argument("--list", action="store_true", dest="list_only",
                     help="list canonical programs and passes, then exit")
-    return ap.parse_args(argv)
+    args = ap.parse_args(argv)
+    if not args.fmt:
+        args.fmt = "text" if args.text else "jsonl"
+    return args
+
+
+def format_github(report, file="benchmarks/budgets.json"):
+    """GitHub workflow-command annotation lines for every unsuppressed
+    error/warning finding (info rows are advisory and stay off the PR).
+    ``file`` anchors the annotation — findings describe compiled
+    programs, not source lines, so the budget file (where the waiver or
+    ceiling would change) is the natural place to hang them."""
+    lines = []
+    for f in report.unsuppressed:
+        title = "%s(%s)%s" % (f.pass_name, f.program,
+                              ":" + f.code if f.code else "")
+        # workflow-command escaping: %, CR, LF in the data
+        msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+               .replace("\n", "%0A"))
+        lines.append("::%s file=%s,line=1,title=%s::%s"
+                     % (f.severity, file, title, msg))
+    return lines
+
+
+def _exit_code(report):
+    """The documented contract: 0 clean/info-only, 1 on unsuppressed
+    errors (usage/input failures exit 2 before a report exists)."""
+    return 1 if report.errors else 0
 
 
 def main(argv=None):
@@ -118,6 +178,7 @@ def main(argv=None):
 
     from mxnet_tpu import analysis
     from mxnet_tpu.analysis.hlo_parse import collective_stats
+    from mxnet_tpu.analysis.schedule import parse_schedule
     from mxnet_tpu.programs import registry as progreg
     import mxnet_tpu.analysis.programs  # noqa: F401 — registers the
     # canonical builder groups with the program registry; --list,
@@ -130,6 +191,14 @@ def main(argv=None):
         for p in analysis.default_passes():
             print("pass:", p.name)
         return 0
+
+    snapshot = None
+    if args.check:
+        try:
+            snapshot = analysis.load_snapshot(args.check)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print("mxlint: --check: %s" % e, file=sys.stderr)
+            return 2
 
     names = [n for n in args.programs.split(",") if n] or None
     artifacts, notes = progreg.build_canonical(names)
@@ -163,12 +232,42 @@ def main(argv=None):
         return 0
 
     report = analysis.run_passes(artifacts, budgets=budgets,
-                                 suppressions=args.suppress)
-    if args.text:
+                                 suppressions=args.suppress,
+                                 snapshot=snapshot)
+
+    if args.record:
+        snap = analysis.record_snapshot(artifacts, report)
+        with open(args.record, "w") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({"recorded": args.record,
+                          "programs": sorted(snap["programs"]),
+                          "content_hash": snap["content_hash"]}),
+              file=sys.stderr)
+
+    if args.fmt == "text":
         print(report.format_text(), file=sys.stderr)
+    elif args.fmt == "json":
+        print(report.to_json(), file=sys.stderr)
+    elif args.fmt == "github":
+        for line in format_github(report):
+            print(line, file=sys.stderr)
     else:
         for f in report.findings:
             print(json.dumps(f.to_dict()), file=sys.stderr)
+
+    # schedule/drift aggregates for the bench contract line — mxstat
+    # --diff flattens these, so overlap structure and drift state ride
+    # the same trend lines as the byte ceilings
+    sched = {"pairs": 0, "unpaired": 0, "serialized": 0}
+    for art in artifacts:
+        if art.compiled_text is not None:
+            s = parse_schedule(art.compiled_text).summary()
+            for k in sched:
+                sched[k] += s[k]
+    drifted = sum(1 for f in report.findings
+                  if f.pass_name == "drift"
+                  and f.code.startswith("drift:") and not f.suppressed)
 
     s = report.summary()
     unsup = len(report.unsuppressed)
@@ -177,8 +276,13 @@ def main(argv=None):
         1.0 if unsup == 0 else 0.0,
         errors=s["errors"], warnings=s["warnings"],
         suppressed=s["suppressed"], programs=s["programs"],
-        passes=s["passes"], skipped_programs=sorted(notes)))
-    return 1 if report.errors else 0
+        passes=s["passes"], skipped_programs=sorted(notes),
+        schedule_pairs=sched["pairs"],
+        schedule_unpaired=sched["unpaired"],
+        schedule_serialized=sched["serialized"],
+        drift_checked=len(artifacts) if snapshot is not None else 0,
+        drifted=drifted))
+    return _exit_code(report)
 
 
 if __name__ == "__main__":

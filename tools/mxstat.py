@@ -133,7 +133,12 @@ _EXTRA_SUFFIXES = (".ratio", ".count", "_ms", "_rate", "_pages",
                    # program readiness, warm AOT cache vs trace+compile
                    "cold_start_s", "cold_start_jit_s", "cold_start_vs_jit",
                    "aot_hits", "aot_misses", "aot_fallbacks",
-                   "programs_loaded")
+                   "programs_loaded",
+                   # the mxlint schedule/drift aggregates: async overlap
+                   # structure and the differential gate's verdict ride
+                   # the same trend lines as the byte ceilings
+                   "_pairs", "_unpaired", "_serialized", "_shadow_flops",
+                   "drift_checked", "drifted")
 
 
 def _flatten_bytes_extras(obj, prefix=""):
@@ -281,6 +286,8 @@ def smoke():
                   "opt_update_bytes": {"per_param_bytes": 1200,
                                        "fused_bytes": 1200,
                                        "ratio": 1.0},
+                  "schedule_pairs": 6, "schedule_serialized": 0,
+                  "drift_checked": 13, "drifted": 0,
                   "mfu_table": [{"program": "train_step", "calls": 10,
                                  "wall_s": 1.0, "flops": 100,
                                  "bytes": 1000, "mfu": 0.15}]}
@@ -289,6 +296,8 @@ def smoke():
                   "opt_update_bytes": {"per_param_bytes": 1200,
                                        "fused_bytes": 540,
                                        "ratio": 0.45},
+                  "schedule_pairs": 4, "schedule_serialized": 2,
+                  "drift_checked": 13, "drifted": 1,
                   "mfu_table": [{"program": "train_step", "calls": 10,
                                  "wall_s": 0.9, "flops": 100,
                                  "bytes": 800, "mfu": 0.17}]}
@@ -307,6 +316,10 @@ def smoke():
             and "-660" in text and "-55.00%" in text
         checks["diff_programs"] = "train_step.bytes" in text \
             and "-200" in text
+        # the mxlint schedule/drift aggregates flatten like byte fields
+        checks["diff_schedule"] = "schedule_pairs" in text \
+            and "schedule_serialized" in text and "+2" in text
+        checks["diff_drift"] = "drifted" in text and "drift_checked" in text
         checks["diff_missing"] = diff(pa, os.devnull,
                                       out=io.StringIO()) == 1
 
